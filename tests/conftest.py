@@ -1,0 +1,24 @@
+"""Pytest config: force a virtual 8-device CPU mesh for sharding tests
+(the real TPU path is exercised by bench.py / the driver)."""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+@pytest.fixture
+def rng(request):
+    """Deterministic per-test PRNG; vary YTPU_TEST_SEED for new random runs
+    (the reference randomizes via lib0/testing's per-run seeds)."""
+    seed = os.environ.get("YTPU_TEST_SEED", "0")
+    digest = hashlib.md5(f"{request.node.nodeid}:{seed}".encode()).hexdigest()
+    return random.Random(int(digest[:16], 16))

@@ -1,0 +1,179 @@
+"""YArray behavior + randomized convergence tests (scenarios modeled on
+reference tests/y-array.tests.js)."""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from helpers import apply_random_tests, compare, init
+
+
+def test_basic_insert_delete():
+    doc = Y.Doc()
+    arr = doc.get_array("arr")
+    arr.insert(0, [1, 2, 3])
+    arr.insert(1, ["x"])
+    assert arr.to_json() == [1, "x", 2, 3]
+    arr.delete(1, 2)
+    assert arr.to_json() == [1, 3]
+    arr.push([4])
+    arr.unshift([0])
+    assert arr.to_json() == [0, 1, 3, 4]
+    assert arr.get(2) == 3
+    assert arr.length == 4
+    assert arr.slice(1, 3) == [1, 3]
+    assert arr.slice(-2) == [3, 4]
+
+
+def test_types_as_content():
+    doc = Y.Doc()
+    arr = doc.get_array("arr")
+    nested = Y.YArray()
+    arr.insert(0, [nested])
+    nested.insert(0, ["inner"])
+    m = Y.YMap({"k": 1})
+    arr.push([m])
+    assert arr.to_json() == [["inner"], {"k": 1}]
+
+
+def test_insert_three_elements_try_re_get(rng):
+    result = init(rng, users=2)
+    array0, array1 = result["array0"], result["array1"]
+    array0.insert(0, [1, True, False])
+    assert array0.to_json() == [1, True, False]
+    result["testConnector"].flush_all_messages()
+    assert array1.to_json() == [1, True, False]
+    compare(result["users"])
+
+
+def test_concurrent_inserts_converge(rng):
+    result = init(rng, users=3)
+    array0, array1, array2 = result["array0"], result["array1"], result["array2"]
+    array0.insert(0, [0])
+    array1.insert(0, [1])
+    array2.insert(0, [2])
+    compare(result["users"])
+
+
+def test_insertions_in_late_sync(rng):
+    result = init(rng, users=3)
+    tc = result["testConnector"]
+    tc.flush_all_messages()
+    result["users"][1].disconnect()
+    result["users"][2].disconnect()
+    result["array0"].insert(1, ["user0"]) if result["array0"].length > 0 else result[
+        "array0"
+    ].insert(0, ["user0"])
+    result["array1"].insert(0, ["user1"])
+    result["array2"].insert(0, ["user2"])
+    result["users"][1].connect()
+    result["users"][2].connect()
+    compare(result["users"])
+
+
+def test_disconnect_really_prevents_sending_messages(rng):
+    result = init(rng, users=3)
+    tc = result["testConnector"]
+    array0, array1 = result["array0"], result["array1"]
+    tc.flush_all_messages()
+    result["users"][1].disconnect()
+    array0.insert(0, ["x"])
+    assert array1.to_json() == []
+    result["users"][1].connect()
+    compare(result["users"])
+
+
+def test_delete_insert_circular(rng):
+    result = init(rng, users=2)
+    array0 = result["array0"]
+    array0.insert(0, ["A", "B", "C"])
+    array0.delete(1, 1)
+    array0.insert(1, ["b"])
+    assert array0.to_json() == ["A", "b", "C"]
+    compare(result["users"])
+
+
+def test_observer_event():
+    doc = Y.Doc()
+    arr = doc.get_array("arr")
+    fired = {}
+
+    def obs(event, txn):
+        fired["added"] = len(event.changes["added"])
+        fired["deleted"] = len(event.changes["deleted"])
+        fired["delta"] = event.changes["delta"]
+
+    arr.observe(obs)
+    arr.insert(0, [1, 2])
+    assert fired["added"] == 1
+    assert fired["delta"] == [{"insert": [1, 2]}]
+    arr.delete(0, 1)
+    assert fired["deleted"] == 1
+    assert fired["delta"] == [{"delete": 1}]
+
+
+def test_observe_deep():
+    doc = Y.Doc()
+    arr = doc.get_array("arr")
+    events = []
+    arr.observe_deep(lambda evts, txn: events.append(evts))
+    nested = Y.YMap()
+    arr.insert(0, [nested])
+    assert len(events) == 1
+    nested.set("key", "value")
+    assert len(events) == 2
+    assert events[1][0].path == [0]
+
+
+# -- randomized convergence fuzzing (reference y-array.tests.js:386-502) ----
+
+_unique_counter = [0]
+
+
+def _unique_number():
+    _unique_counter[0] += 1
+    return _unique_counter[0]
+
+
+def _insert_generic(user, gen: random.Random):
+    arr = user.get_array("array")
+    pos = gen.randint(0, arr.length)
+    arr.insert(pos, [_unique_number() for _ in range(gen.randint(1, 4))])
+
+
+def _insert_type_array(user, gen: random.Random):
+    arr = user.get_array("array")
+    pos = gen.randint(0, arr.length)
+    nested = Y.YArray()
+    arr.insert(pos, [nested])
+    nested.insert(0, [gen.randint(0, 10), gen.randint(0, 10)])
+
+
+def _insert_text(user, gen: random.Random):
+    arr = user.get_array("array")
+    pos = gen.randint(0, arr.length)
+    arr.insert(pos, ["str" + str(gen.randint(0, 100))])
+
+
+def _delete_generic(user, gen: random.Random):
+    arr = user.get_array("array")
+    length = arr.length
+    if length > 0:
+        pos = gen.randint(0, length - 1)
+        del_length = min(gen.randint(1, 2), length - pos)
+        if gen.random() < 0.5:
+            item = arr.get(pos)
+            if isinstance(item, Y.YArray) and item.length > 0:
+                pos2 = gen.randint(0, item.length - 1)
+                item.delete(pos2, min(gen.randint(1, 2), item.length - pos2))
+                return
+        arr.delete(pos, del_length)
+
+
+ARRAY_MODS = [_insert_generic, _insert_type_array, _insert_text, _delete_generic]
+
+
+@pytest.mark.parametrize("iterations", [6, 40, 120])
+def test_repeat_random_array_ops(rng, iterations):
+    apply_random_tests(rng, ARRAY_MODS, iterations)

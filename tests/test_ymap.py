@@ -1,0 +1,124 @@
+"""YMap behavior + randomized convergence (scenarios modeled on reference
+tests/y-map.tests.js)."""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from helpers import apply_random_tests, compare, init
+
+
+def test_basic_map_ops():
+    doc = Y.Doc()
+    m = doc.get_map("map")
+    m.set("a", 1)
+    m.set("b", "two")
+    m.set("c", {"nested": True})
+    assert m.get("a") == 1
+    assert m.has("b")
+    assert not m.has("zz")
+    assert m.size == 3
+    m.delete("b")
+    assert m.size == 2
+    assert m.get("b") is None
+    assert sorted(m.keys()) == ["a", "c"]
+    assert m.to_json() == {"a": 1, "c": {"nested": True}}
+
+
+def test_map_prelim():
+    m = Y.YMap({"x": 10})
+    m.set("y", 20)
+    doc = Y.Doc()
+    doc.get_array("a").insert(0, [m])
+    assert m.get("x") == 10
+    assert m.to_json() == {"x": 10, "y": 20}
+
+
+def test_map_last_writer_wins(rng):
+    result = init(rng, users=3)
+    result["map0"].set("key", "c0")
+    result["map1"].set("key", "c1")
+    result["map2"].set("key", "c2")
+    compare(result["users"])
+    # highest client id wins concurrent map sets
+    assert result["users"] == result["users"]
+
+
+def test_get_and_set_and_delete(rng):
+    result = init(rng, users=3)
+    map0 = result["map0"]
+    map0.set("stuff", "c0")
+    map0.delete("stuff")
+    result["testConnector"].flush_all_messages()
+    for u in result["users"]:
+        assert u.get_map("map").get("stuff") is None
+    compare(result["users"])
+
+
+def test_concurrent_set_converges(rng):
+    result = init(rng, users=3)
+    result["testConnector"].flush_all_messages()
+    result["map0"].set("k", "v0")
+    result["map1"].set("k", "v1")
+    compare(result["users"])
+
+
+def test_map_events():
+    doc = Y.Doc()
+    m = doc.get_map("map")
+    events = []
+    m.observe(lambda e, txn: events.append(dict(e.changes["keys"])))
+    m.set("a", 1)
+    assert events[-1]["a"]["action"] == "add"
+    m.set("a", 2)
+    assert events[-1]["a"]["action"] == "update"
+    assert events[-1]["a"]["oldValue"] == 1
+    m.delete("a")
+    assert events[-1]["a"]["action"] == "delete"
+    assert events[-1]["a"]["oldValue"] == 2
+
+
+def test_nested_maps():
+    doc = Y.Doc()
+    m = doc.get_map("map")
+    inner = Y.YMap()
+    m.set("inner", inner)
+    inner.set("deep", Y.YArray())
+    inner.get("deep").push([1])
+    assert m.to_json() == {"inner": {"deep": [1]}}
+    assert m.get("inner").parent is m
+
+
+# -- randomized fuzz (reference y-map.tests.js:426-606) ---------------------
+
+def _set_key(user, gen: random.Random):
+    key = gen.choice(["one", "two"])
+    value = "val" + str(gen.randint(0, 100))
+    user.get_map("map").set(key, value)
+
+
+def _set_type(user, gen: random.Random):
+    key = gen.choice(["one", "two"])
+    typ = gen.choice(["array", "map"])
+    if typ == "array":
+        nested = Y.YArray()
+        user.get_map("map").set(key, nested)
+        nested.insert(0, [gen.randint(0, 10) for _ in range(3)])
+    else:
+        nested = Y.YMap()
+        user.get_map("map").set(key, nested)
+        nested.set("deepkey", "deepvalue" + str(gen.randint(0, 10)))
+
+
+def _delete_key(user, gen: random.Random):
+    key = gen.choice(["one", "two"])
+    user.get_map("map").delete(key)
+
+
+MAP_MODS = [_set_key, _set_type, _delete_key]
+
+
+@pytest.mark.parametrize("iterations", [6, 40, 120])
+def test_repeat_random_map_ops(rng, iterations):
+    apply_random_tests(rng, MAP_MODS, iterations)

@@ -1,0 +1,11 @@
+from .protocol import (  # noqa: F401
+    MESSAGE_YJS_SYNC_STEP_1,
+    MESSAGE_YJS_SYNC_STEP_2,
+    MESSAGE_YJS_UPDATE,
+    read_sync_message,
+    read_sync_step1,
+    read_sync_step2,
+    write_sync_step1,
+    write_sync_step2,
+    write_update,
+)

@@ -1,0 +1,64 @@
+"""The 2-step state-vector sync handshake.
+
+The reference keeps this in the external y-protocols package (see reference
+INTERNALS.md:145-166 and tests/testHelper.js:6,51-52,160); here it is a
+first-class framework module, byte-compatible with y-protocols/sync.js:
+
+- step 1: send your state vector
+- step 2: reply with `encode_state_as_update(doc, remote_sv)`
+- update: incremental broadcast
+
+Transport framing beyond these 3 message types (websocket, webrtc, ...)
+remains provider territory (yjs_tpu/provider).
+"""
+
+from __future__ import annotations
+
+from ..core import Doc
+from ..lib0 import decoding, encoding
+from ..lib0.decoding import Decoder
+from ..lib0.encoding import Encoder
+from ..updates import apply_update, encode_state_as_update, encode_state_vector
+
+MESSAGE_YJS_SYNC_STEP_1 = 0
+MESSAGE_YJS_SYNC_STEP_2 = 1
+MESSAGE_YJS_UPDATE = 2
+
+
+def write_sync_step1(encoder: Encoder, doc: Doc) -> None:
+    encoding.write_var_uint(encoder, MESSAGE_YJS_SYNC_STEP_1)
+    encoding.write_var_uint8_array(encoder, encode_state_vector(doc))
+
+
+def write_sync_step2(encoder: Encoder, doc: Doc, encoded_state_vector: bytes | None = None) -> None:
+    encoding.write_var_uint(encoder, MESSAGE_YJS_SYNC_STEP_2)
+    encoding.write_var_uint8_array(encoder, encode_state_as_update(doc, encoded_state_vector))
+
+
+def read_sync_step1(decoder: Decoder, encoder: Encoder, doc: Doc) -> None:
+    write_sync_step2(encoder, doc, decoding.read_var_uint8_array(decoder))
+
+
+def read_sync_step2(decoder: Decoder, doc: Doc, transaction_origin=None) -> None:
+    apply_update(doc, decoding.read_var_uint8_array(decoder), transaction_origin)
+
+
+def write_update(encoder: Encoder, update: bytes) -> None:
+    encoding.write_var_uint(encoder, MESSAGE_YJS_UPDATE)
+    encoding.write_var_uint8_array(encoder, update)
+
+
+read_update_message = read_sync_step2
+
+
+def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_origin=None) -> int:
+    message_type = decoding.read_var_uint(decoder)
+    if message_type == MESSAGE_YJS_SYNC_STEP_1:
+        read_sync_step1(decoder, encoder, doc)
+    elif message_type == MESSAGE_YJS_SYNC_STEP_2:
+        read_sync_step2(decoder, doc, transaction_origin)
+    elif message_type == MESSAGE_YJS_UPDATE:
+        read_update_message(decoder, doc, transaction_origin)
+    else:
+        raise ValueError(f"unknown sync message type {message_type}")
+    return message_type
