@@ -1,0 +1,248 @@
+"""Convergence tests: the TPU batch engine vs the CPU reference core.
+
+The oracle (mirroring tests/testHelper.js compare(), reference
+tests/testHelper.js:274-313): after applying the same updates, the device
+engine must produce the same document text, the same state vector, and the
+same element order as the CPU core.
+"""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.ops import BatchEngine
+
+
+def cpu_rows_in_order(doc: Y.Doc, name: str = "text"):
+    """(client, clock, length, deleted) per item in list order, split to the
+    same granularity the engine reports (runs may differ; flatten to unit
+    granularity for comparison)."""
+    out = []
+    item = doc.get_text(name)._start
+    while item is not None:
+        for off in range(item.length):
+            out.append((item.id.client, item.id.clock + off, item.deleted))
+        item = item.right
+    return out
+
+
+def engine_rows_unit(eng: BatchEngine, i: int):
+    out = []
+    for client, clock, length, deleted in eng.rows_in_order(i):
+        for off in range(length):
+            out.append((client, clock + off, deleted))
+    return out
+
+
+def make_doc(client_id: int) -> Y.Doc:
+    d = Y.Doc(gc=False)
+    d.client_id = client_id
+    return d
+
+
+def assert_engine_matches(eng, doc: Y.Doc, idx=0, name="text"):
+    assert eng.text(idx) == doc.get_text(name).to_string()
+    assert eng.state_vector(idx) == {
+        c: v for c, v in Y.get_state_vector(doc.store).items() if v > 0
+    }
+    assert engine_rows_unit(eng, idx) == cpu_rows_in_order(doc, name)
+
+
+def replay_into_engine(updates, n_docs=1, v2=False):
+    eng = BatchEngine(n_docs)
+    for i in range(n_docs):
+        for u in updates:
+            eng.queue_update(i, u, v2=v2)
+    eng.flush()
+    return eng
+
+
+def collect_updates(doc: Y.Doc):
+    """Record incremental update blobs from a doc."""
+    updates = []
+    doc.on("update", lambda u, origin, d: updates.append(u))
+    return updates
+
+
+class TestAppendOnly:
+    def test_single_client_appends(self):
+        doc = make_doc(1)
+        updates = collect_updates(doc)
+        t = doc.get_text("text")
+        for i in range(50):
+            t.insert(len(t.to_string()), f"w{i} ")
+        eng = replay_into_engine(updates)
+        assert_engine_matches(eng, doc)
+
+    def test_full_state_update(self):
+        doc = make_doc(1)
+        t = doc.get_text("text")
+        t.insert(0, "hello world")
+        t.insert(5, ", brave")
+        eng = replay_into_engine([Y.encode_state_as_update(doc)])
+        assert_engine_matches(eng, doc)
+
+
+class TestConcurrent:
+    def test_two_clients_interleaved(self):
+        a, b = make_doc(1), make_doc(2)
+        ua, ub = collect_updates(a), collect_updates(b)
+        a.get_text("text").insert(0, "aaa")
+        b.get_text("text").insert(0, "bbb")
+        # cross-sync (updates are idempotent+commutative: deliver everything)
+        for u in list(ub):
+            Y.apply_update(a, u)
+        for u in list(ua):
+            Y.apply_update(b, u)
+        a.get_text("text").insert(3, "XYZ")
+        b.get_text("text").insert(1, "qq")
+        for u in list(ub):
+            Y.apply_update(a, u)
+        for u in list(ua):
+            Y.apply_update(b, u)
+        assert a.get_text("text").to_string() == b.get_text("text").to_string()
+        eng = replay_into_engine(ua + ub)
+        assert_engine_matches(eng, a)
+
+    def test_concurrent_same_position(self):
+        docs = [make_doc(i + 1) for i in range(4)]
+        upds = [collect_updates(d) for d in docs]
+        for i, d in enumerate(docs):
+            d.get_text("text").insert(0, f"<{i}>")
+        all_updates = [u for us in upds for u in us]
+        for d in docs:
+            for u in all_updates:
+                Y.apply_update(d, u)
+        for d in docs[1:]:
+            assert d.get_text("text").to_string() == docs[0].get_text("text").to_string()
+        eng = replay_into_engine(all_updates)
+        assert_engine_matches(eng, docs[0])
+
+    def test_deletes(self):
+        a, b = make_doc(1), make_doc(2)
+        ua, ub = collect_updates(a), collect_updates(b)
+        a.get_text("text").insert(0, "abcdefgh")
+        for u in list(ua):
+            Y.apply_update(b, u)
+        a.get_text("text").delete(2, 3)
+        b.get_text("text").insert(4, "ZZ")
+        for u in list(ub):
+            Y.apply_update(a, u)
+        for u in list(ua):
+            Y.apply_update(b, u)
+        assert a.get_text("text").to_string() == b.get_text("text").to_string()
+        eng = replay_into_engine(ua + ub)
+        assert_engine_matches(eng, a)
+
+    def test_out_of_order_delivery_buffers_pending(self):
+        doc = make_doc(7)
+        updates = collect_updates(doc)
+        t = doc.get_text("text")
+        t.insert(0, "one ")
+        t.insert(4, "two ")
+        t.insert(8, "three")
+        eng = BatchEngine(1)
+        # deliver newest first: must park in pending, then resolve
+        eng.queue_update(0, updates[2])
+        eng.flush()
+        assert eng.has_pending(0)
+        eng.queue_update(0, updates[0])
+        eng.queue_update(0, updates[1])
+        eng.flush()
+        assert not eng.has_pending(0)
+        assert_engine_matches(eng, doc)
+
+
+class TestRandomizedConvergence:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_text_edits(self, seed):
+        gen = random.Random(seed)
+        n_clients = gen.randint(2, 4)
+        docs = [make_doc(i + 1) for i in range(n_clients)]
+        upds = [collect_updates(d) for d in docs]
+        sent: list[int] = [0] * n_clients  # per-doc cursor into peers
+        for _ in range(40):
+            i = gen.randrange(n_clients)
+            d = docs[i]
+            t = d.get_text("text")
+            ln = len(t.to_string())
+            op = gen.random()
+            if op < 0.65 or ln == 0:
+                pos = gen.randint(0, ln)
+                t.insert(pos, gen.choice(["a", "bb", "ccc", "x", "🙂"]))
+            else:
+                pos = gen.randrange(ln)
+                t.delete(pos, min(gen.randint(1, 3), ln - pos))
+            if gen.random() < 0.3:
+                # deliver a random peer's pending updates to a random doc
+                src = gen.randrange(n_clients)
+                dst = gen.randrange(n_clients)
+                for u in upds[src]:
+                    Y.apply_update(docs[dst], u)
+        # final full sync
+        all_updates = [u for us in upds for u in us]
+        gen.shuffle(all_updates)
+        for d in docs:
+            for u in all_updates:
+                Y.apply_update(d, u)
+        for d in docs[1:]:
+            assert d.get_text("text").to_string() == docs[0].get_text("text").to_string()
+        eng = replay_into_engine(all_updates)
+        assert not eng.has_pending(0)
+        assert_engine_matches(eng, docs[0])
+
+    def test_v2_encoding(self):
+        doc = make_doc(3)
+        t = doc.get_text("text")
+        t.insert(0, "hello")
+        t.insert(2, "XX")
+        t.delete(1, 3)
+        eng = BatchEngine(1)
+        eng.queue_update(0, Y.encode_state_as_update_v2(doc), v2=True)
+        eng.flush()
+        assert_engine_matches(eng, doc)
+
+
+class TestBatch:
+    def test_many_docs_one_flush(self):
+        n = 16
+        docs = [make_doc(100 + i) for i in range(n)]
+        eng = BatchEngine(n)
+        for i, d in enumerate(docs):
+            t = d.get_text("text")
+            t.insert(0, f"doc-{i}:")
+            t.insert(len(t.to_string()), "payload" * (i % 3 + 1))
+            t.delete(0, 2)
+            eng.queue_update(i, Y.encode_state_as_update(d))
+        eng.flush()
+        for i, d in enumerate(docs):
+            assert eng.text(i) == d.get_text("text").to_string()
+            assert_engine_matches(eng, d, idx=i)
+
+    def test_incremental_flushes(self):
+        doc = make_doc(5)
+        updates = collect_updates(doc)
+        t = doc.get_text("text")
+        eng = BatchEngine(1)
+        for step in range(6):
+            t.insert(len(t.to_string()) // 2, f"[{step}]")
+            if step % 2 == 1:
+                t.delete(0, 1)
+            for u in updates:
+                eng.queue_update(0, u)
+            updates.clear()
+            eng.flush()
+            assert_engine_matches(eng, doc)
+
+
+class TestFallback:
+    def test_map_update_demotes_to_cpu(self):
+        doc = make_doc(9)
+        doc.get_map("m").set("k", 1)
+        doc.get_text("text").insert(0, "hi")
+        eng = BatchEngine(1)
+        eng.queue_update(0, Y.encode_state_as_update(doc))
+        eng.flush()
+        assert 0 in eng.fallback
+        assert eng.text(0) == "hi"

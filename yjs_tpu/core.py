@@ -580,12 +580,7 @@ def read_content_doc(decoder):
     guid = decoder.read_string()
     opts = decoder.read_any() or {}
     kwargs = {"guid": guid}
-    if "gc" in opts:
-        kwargs["gc"] = opts["gc"]
-    if "autoLoad" in opts:
-        kwargs["auto_load"] = opts["autoLoad"]
-    if "meta" in opts:
-        kwargs["meta"] = opts["meta"]
+    kwargs.update(_opts_to_kwargs(opts))
     return ContentDoc(Doc(**kwargs))
 
 

@@ -1,0 +1,4 @@
+"""TPU-native batch ops: columnar transcoding + JAX kernels + BatchEngine."""
+
+from .columns import DocMirror, ItemRef, StepPlan, UnsupportedUpdate, decode_update_refs  # noqa: F401
+from .engine import BatchEngine  # noqa: F401

@@ -1,0 +1,586 @@
+"""Host-side columnar (struct-of-arrays) transcoding for the TPU batch engine.
+
+This module replaces the reference's pointer-graph decode/integrate prelude
+(reference src/utils/encoding.js:127-198, src/structs/Item.js:354-397) with a
+columnar pipeline:
+
+  wire update bytes
+    -> ``ItemRef`` records (flat decode, no Doc required)
+    -> causal schedule  (the dependency-stack integrator of
+       encoding.js:225-321, recast as a per-client queue fixpoint)
+    -> pre-split pass   (all run splits computed *before* device integration,
+       mirroring what Snapshot.splitSnapshotAffectedStructs does for
+       snapshots — reference src/utils/Snapshot.js:141-154 — so the device
+       item table is static)
+    -> ``StepPlan``     (padded int32 columns ready for the JAX kernel)
+
+The :class:`DocMirror` is the host twin of one document's struct store: it owns
+the immutable per-row columns (client, clock, length, origin, rightOrigin) and
+the variable-length payloads (content objects live host-side only; device
+memory holds fixed-width columns, per SURVEY.md §7 core data layout).  The
+device owns the *dynamic* integration state: linked-list links, list head,
+deleted bits.
+
+Pre-splitting is sound because YATA placement of a run is determined
+element-wise by (origin, rightOrigin, client) — integrating the fragments of a
+run (each fragment's origin = last id of its left sibling fragment, rightOrigin
+inherited, exactly the splitItem rule of reference src/structs/Item.js:84-120)
+yields the same total order as integrating the whole run and splitting later.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..coding import UpdateDecoderV1, UpdateDecoderV2
+from ..core import (
+    GC,
+    ContentDeleted,
+    ContentDoc,
+    ContentType,
+    read_item_content,
+)
+from ..lib0 import decoding
+from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
+from ..lib0.decoding import Decoder
+
+NULL = -1  # null id / null row sentinel in every int column
+
+
+# ---------------------------------------------------------------------------
+# Flat decode: wire bytes -> ItemRef records (no Doc involved)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ItemRef:
+    """A decoded, not-yet-integrated struct (Item or GC) as plain data."""
+
+    client: int
+    clock: int
+    length: int
+    origin: tuple[int, int] | None = None  # (client, clock)
+    right_origin: tuple[int, int] | None = None
+    parent_name: str | None = None  # root-type key
+    parent_id: tuple[int, int] | None = None  # nested parent (CPU-only path)
+    parent_sub: str | None = None
+    content: object | None = None  # AbstractContent; None for GC refs
+    is_gc: bool = False
+
+    def split(self, offset: int) -> "ItemRef":
+        """Split off and return the right part at ``offset`` elements
+        (reference src/structs/Item.js:84-120 field rules)."""
+        right_content = self.content.splice(offset)
+        right = ItemRef(
+            client=self.client,
+            clock=self.clock + offset,
+            length=self.length - offset,
+            origin=(self.client, self.clock + offset - 1),
+            right_origin=self.right_origin,
+            parent_name=self.parent_name,
+            parent_id=self.parent_id,
+            parent_sub=self.parent_sub,
+            content=right_content,
+        )
+        self.length = offset
+        return right
+
+    def trim_left(self, offset: int) -> None:
+        """Drop the first ``offset`` already-known elements (the dedup
+        `offset` path of reference src/structs/Item.js:745-755 and
+        GC.js integrate)."""
+        if self.content is not None:
+            self.content = self.content.splice(offset)
+        self.clock += offset
+        self.length -= offset
+        if not self.is_gc:
+            self.origin = (self.client, self.clock - 1)
+
+
+def decode_update_refs(update: bytes, v2: bool):
+    """Decode an update into (refs_per_client, delete_ranges) without a Doc.
+
+    Mirrors reference src/utils/encoding.js:127-198 (struct section) and
+    src/utils/DeleteSet.js:270-285 (DS section header/ranges), but resolves
+    nothing — root parents stay names, origins stay IDs.
+    """
+    decoder = Decoder(update)
+    yd = UpdateDecoderV2(decoder) if v2 else UpdateDecoderV1(decoder)
+    refs: dict[int, list[ItemRef]] = {}
+    num_of_state_updates = decoding.read_var_uint(yd.rest_decoder)
+    for _ in range(num_of_state_updates):
+        number_of_structs = decoding.read_var_uint(yd.rest_decoder)
+        client = yd.read_client()
+        clock = decoding.read_var_uint(yd.rest_decoder)
+        out = refs.setdefault(client, [])
+        for _ in range(number_of_structs):
+            info = yd.read_info()
+            if (BITS5 & info) != 0:
+                cant_copy_parent_info = (info & (BIT7 | BIT8)) == 0
+                origin = yd.read_left_id() if (info & BIT8) == BIT8 else None
+                right_origin = yd.read_right_id() if (info & BIT7) == BIT7 else None
+                parent_name = None
+                parent_id = None
+                if cant_copy_parent_info:
+                    if yd.read_parent_info():
+                        parent_name = yd.read_string()
+                    else:
+                        pid = yd.read_left_id()
+                        parent_id = (pid.client, pid.clock)
+                parent_sub = (
+                    yd.read_string()
+                    if cant_copy_parent_info and (info & BIT6) == BIT6
+                    else None
+                )
+                content = read_item_content(yd, info)
+                ref = ItemRef(
+                    client=client,
+                    clock=clock,
+                    length=content.get_length(),
+                    origin=None if origin is None else (origin.client, origin.clock),
+                    right_origin=None
+                    if right_origin is None
+                    else (right_origin.client, right_origin.clock),
+                    parent_name=parent_name,
+                    parent_id=parent_id,
+                    parent_sub=parent_sub,
+                    content=content,
+                )
+                out.append(ref)
+                clock += ref.length
+            else:
+                ln = yd.read_len()
+                out.append(ItemRef(client=client, clock=clock, length=ln, is_gc=True))
+                clock += ln
+
+    # DS section (reference DeleteSet.js:270-285): (client, clock, len) ranges
+    ds: list[tuple[int, int, int]] = []
+    num_clients = decoding.read_var_uint(yd.rest_decoder)
+    for _ in range(num_clients):
+        yd.reset_ds_cur_val()
+        client = decoding.read_var_uint(yd.rest_decoder)
+        num_deletes = decoding.read_var_uint(yd.rest_decoder)
+        for _ in range(num_deletes):
+            ds.append((client, yd.read_ds_clock(), yd.read_ds_len()))
+    return refs, ds
+
+
+class UnsupportedUpdate(Exception):
+    """The update uses features outside the device path's scope (nested
+    types, map entries, subdocuments); the owning doc must fall back to the
+    CPU reference core (the Provider gating of BASELINE.json's north star)."""
+
+
+# ---------------------------------------------------------------------------
+# StepPlan: what one flush hands to the device kernel for one doc
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StepPlan:
+    """Per-doc inputs for one device integration step (un-padded)."""
+
+    n_rows: int  # total rows in the mirror after this step
+    # splits of already-integrated rows: (orig_row, new_row), ordered so that
+    # multiple cuts of one original run appear right-to-left
+    splits: list[tuple[int, int]] = field(default_factory=list)
+    # integration schedule: (row, left_row, right_row) in causal order
+    sched: list[tuple[int, int, int]] = field(default_factory=list)
+    # rows to mark deleted after integration
+    delete_rows: list[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# DocMirror: host twin of one document
+# ---------------------------------------------------------------------------
+
+
+class DocMirror:
+    """Host columnar mirror of one doc: immutable struct columns + payloads.
+
+    Row indices are stable forever (append-only; splits append the right
+    fragment as a new row).  The per-client fragment index maps (client,
+    clock) -> row for origin/rightOrigin resolution, the columnar analogue of
+    StructStore.find (reference src/utils/StructStore.js:123-177).
+    """
+
+    def __init__(self, root_name: str = "text"):
+        self.root_name = root_name
+        # client <-> dense slot mapping
+        self.client_of_slot: list[int] = []
+        self.slot_of_client: dict[int, int] = {}
+        # per-row columns (python lists; converted to numpy at flush)
+        self.row_slot: list[int] = []
+        self.row_clock: list[int] = []
+        self.row_len: list[int] = []
+        self.row_origin_slot: list[int] = []
+        self.row_origin_clock: list[int] = []
+        self.row_right_slot: list[int] = []
+        self.row_right_clock: list[int] = []
+        self.row_is_gc: list[bool] = []
+        self.row_countable: list[bool] = []
+        self.row_content: list[object | None] = []
+        # per-slot fragment index, sorted by clock
+        self.frag_clock: list[list[int]] = []
+        self.frag_row: list[list[int]] = []
+        # per-slot state (next expected clock)
+        self.state: list[int] = []
+        # causally-early refs parked until their deps arrive
+        # (reference StructStore pendingClientsStructRefs, StructStore.js:25-35)
+        self.pending: dict[int, list[ItemRef]] = {}
+        # delete ranges beyond known state (reference DeleteSet.js:317-322)
+        self.pending_ds: list[tuple[int, int, int]] = []
+        # applied delete ranges per slot (host bookkeeping for sync/export)
+        self.ds: dict[int, list[tuple[int, int]]] = {}
+        # updates queued since the last flush
+        self._incoming: list[tuple[bytes, bool]] = []
+
+    # -- client slots -------------------------------------------------------
+
+    def slot(self, client: int) -> int:
+        s = self.slot_of_client.get(client)
+        if s is None:
+            s = len(self.client_of_slot)
+            self.slot_of_client[client] = s
+            self.client_of_slot.append(client)
+            self.frag_clock.append([])
+            self.frag_row.append([])
+            self.state.append(0)
+        return s
+
+    def get_state(self, client: int) -> int:
+        s = self.slot_of_client.get(client)
+        return 0 if s is None else self.state[s]
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.row_slot)
+
+    # -- row / fragment bookkeeping ----------------------------------------
+
+    def _add_row(self, slot, clock, length, origin, right_origin, is_gc, content):
+        row = len(self.row_slot)
+        self.row_slot.append(slot)
+        self.row_clock.append(clock)
+        self.row_len.append(length)
+        if origin is None:
+            self.row_origin_slot.append(NULL)
+            self.row_origin_clock.append(0)
+        else:
+            self.row_origin_slot.append(self.slot(origin[0]))
+            self.row_origin_clock.append(origin[1])
+        if right_origin is None:
+            self.row_right_slot.append(NULL)
+            self.row_right_clock.append(0)
+        else:
+            self.row_right_slot.append(self.slot(right_origin[0]))
+            self.row_right_clock.append(right_origin[1])
+        self.row_is_gc.append(is_gc)
+        self.row_countable.append(bool(content is not None and content.countable))
+        self.row_content.append(content)
+        # fragment index insert (appends are the common case)
+        fc, fr = self.frag_clock[slot], self.frag_row[slot]
+        if not fc or clock > fc[-1]:
+            fc.append(clock)
+            fr.append(row)
+        else:
+            i = bisect.bisect_left(fc, clock)
+            fc.insert(i, clock)
+            fr.insert(i, row)
+        end = clock + length
+        if end > self.state[slot]:
+            self.state[slot] = end
+        return row
+
+    def _frag_containing(self, slot: int, clock: int) -> int | None:
+        """Index into the fragment lists of the fragment covering ``clock``."""
+        fc = self.frag_clock[slot]
+        i = bisect.bisect_right(fc, clock) - 1
+        if i < 0:
+            return None
+        row = self.frag_row[slot][i]
+        if clock < self.row_clock[row] + self.row_len[row]:
+            return i
+        return None
+
+    def _split_existing(self, slot: int, frag_idx: int, at_clock: int, plan: StepPlan):
+        """Split an integrated row so a fragment starts at ``at_clock``;
+        record the link-surgery instruction for the device."""
+        row = self.frag_row[slot][frag_idx]
+        offset = at_clock - self.row_clock[row]
+        content = self.row_content[row]
+        right_content = content.splice(offset)
+        new_row = self._add_row(
+            slot,
+            at_clock,
+            self.row_len[row] - offset,
+            (self.client_of_slot[slot], at_clock - 1),
+            self._right_origin_of(row),
+            False,
+            right_content,
+        )
+        self.row_len[row] = offset
+        plan.splits.append((row, new_row))
+        return new_row
+
+    def _right_origin_of(self, row: int):
+        rs = self.row_right_slot[row]
+        if rs == NULL:
+            return None
+        return (self.client_of_slot[rs], self.row_right_clock[row])
+
+    # -- update ingestion ---------------------------------------------------
+
+    def ingest(self, update: bytes, v2: bool = False) -> None:
+        self._incoming.append((update, v2))
+
+    def _check_supported(self, ref: ItemRef) -> None:
+        if ref.is_gc:
+            return
+        if ref.parent_id is not None or ref.parent_sub is not None:
+            raise UnsupportedUpdate("nested parent / map entry")
+        if ref.parent_name is not None and ref.parent_name != self.root_name:
+            raise UnsupportedUpdate(f"root type {ref.parent_name!r}")
+        if isinstance(ref.content, (ContentType, ContentDoc)):
+            raise UnsupportedUpdate(type(ref.content).__name__)
+
+    # -- the flush pipeline -------------------------------------------------
+
+    def prepare_step(self) -> StepPlan:
+        """Consume queued updates and produce the device step plan.
+
+        Raises :class:`UnsupportedUpdate` (before mutating any state) if an
+        incoming ref is outside the device path's scope.
+        """
+        incoming: dict[int, list[ItemRef]] = {}
+        ds_ranges: list[tuple[int, int, int]] = list(self.pending_ds)
+        for update, v2 in self._incoming:
+            refs, ds = decode_update_refs(update, v2)
+            for client, rs in refs.items():
+                for r in rs:
+                    self._check_supported(r)
+                incoming.setdefault(client, []).extend(rs)
+            ds_ranges.extend(ds)
+        self._incoming.clear()
+        self.pending_ds = []
+
+        # merge incoming refs into the pending queues, clock-sorted
+        for client, rs in incoming.items():
+            q = self.pending.setdefault(client, [])
+            q.extend(rs)
+            q.sort(key=lambda r: r.clock)
+
+        # -- causal scheduling (encoding.js:225-321 recast as a fixpoint) --
+        sched: list[ItemRef] = []
+        overlay: dict[int, int] = {}  # client -> state incl. scheduled
+
+        def state_of(client: int) -> int:
+            s = overlay.get(client)
+            return self.get_state(client) if s is None else s
+
+        def dep_ok(dep, client) -> bool:
+            # reference Item.getMissing: a dep on another client is satisfied
+            # once state > dep.clock (Item.js:354-397)
+            return dep is None or dep[0] == client or state_of(dep[0]) > dep[1]
+
+        progress = True
+        while progress:
+            progress = False
+            for client in sorted(self.pending.keys(), reverse=True):
+                q = self.pending[client]
+                while q:
+                    ref = q[0]
+                    st = state_of(client)
+                    if ref.clock > st:
+                        break  # clock gap: wait for the missing update
+                    if ref.clock + ref.length <= st:
+                        q.pop(0)  # fully known: dedupe
+                        progress = True
+                        continue
+                    if not (dep_ok(ref.origin, client) and dep_ok(ref.right_origin, client)):
+                        break
+                    if ref.clock < st:
+                        ref.trim_left(st - ref.clock)
+                    q.pop(0)
+                    sched.append(ref)
+                    overlay[client] = ref.clock + ref.length
+                    progress = True
+        for client in [c for c, q in self.pending.items() if not q]:
+            del self.pending[client]
+
+        # -- delete-set clamping against post-step state -------------------
+        # (reference DeleteSet.js:270-323: apply the known prefix, park the
+        # rest in pendingDeleteReaders)
+        applicable: list[tuple[int, int, int]] = []
+        for client, clock, ln in ds_ranges:
+            st = state_of(client)
+            if clock < st:
+                applicable.append((client, clock, min(ln, st - clock)))
+            if clock + ln > st:
+                lo = max(clock, st)
+                self.pending_ds.append((client, lo, clock + ln - lo))
+
+        # -- pre-split pass: collect every boundary the step needs ---------
+        cuts: dict[int, set[int]] = {}
+
+        def need_start(client: int, clock: int) -> None:
+            cuts.setdefault(client, set()).add(clock)
+
+        for ref in sched:
+            if ref.origin is not None:
+                need_start(ref.origin[0], ref.origin[1] + 1)
+            if ref.right_origin is not None:
+                need_start(ref.right_origin[0], ref.right_origin[1])
+        for client, clock, ln in applicable:
+            need_start(client, clock)
+            need_start(client, clock + ln)
+
+        plan = StepPlan(n_rows=0)
+
+        # cuts inside scheduled refs: fragment the refs themselves
+        by_client_sched: dict[int, list[int]] = {}
+        for i, ref in enumerate(sched):
+            by_client_sched.setdefault(ref.client, []).append(i)
+        frag_sched: list[ItemRef] = []
+        replacement: dict[int, list[ItemRef]] = {}
+        for client, idxs in by_client_sched.items():
+            ks = cuts.get(client)
+            if not ks:
+                continue
+            for i in idxs:
+                ref = sched[i]
+                if ref.is_gc:
+                    continue
+                inner = sorted(k for k in ks if ref.clock < k < ref.clock + ref.length)
+                if not inner:
+                    continue
+                parts = [ref]
+                for k in inner:
+                    parts.append(parts[-1].split(k - parts[-1].clock))
+                replacement[i] = parts
+        for i, ref in enumerate(sched):
+            frag_sched.extend(replacement.get(i, [ref]))
+
+        # cuts inside existing rows: split + device link surgery.
+        # ascending order keeps the fragment index consistent; per original
+        # row the device instructions must run right-to-left, so sort the
+        # emitted (row, new_row) pairs afterwards.
+        pre_split_marker = len(plan.splits)
+        for client, ks in cuts.items():
+            slot = self.slot_of_client.get(client)
+            if slot is None:
+                continue
+            for k in sorted(ks):
+                fi = self._frag_containing(slot, k)
+                if fi is None:
+                    continue
+                row = self.frag_row[slot][fi]
+                if self.row_is_gc[row] or self.row_clock[row] == k:
+                    continue  # GC runs are never split (StructStore.js:184-207)
+                self._split_existing(slot, fi + 0, k, plan)
+        # right-to-left per original row: new_row descending within same orig
+        plan.splits[pre_split_marker:] = sorted(
+            plan.splits[pre_split_marker:], key=lambda p: (p[0], -p[1])
+        )
+
+        # -- row assignment + pointer resolution ---------------------------
+        for ref in frag_sched:
+            slot = self.slot(ref.client)
+            if ref.is_gc:
+                self._add_row(slot, ref.clock, ref.length, None, None, True, None)
+                continue
+            left_row = right_row = NULL
+            degrade = False
+            if ref.origin is not None:
+                oslot = self.slot(ref.origin[0])
+                fi = self._frag_containing(oslot, ref.origin[1])
+                if fi is None:
+                    raise AssertionError("scheduled ref with unresolved origin")
+                left_row = self.frag_row[oslot][fi]
+                if self.row_is_gc[left_row]:
+                    degrade = True  # neighbour was GC'd (Item.js:380-395)
+            if ref.right_origin is not None:
+                rslot = self.slot(ref.right_origin[0])
+                fi = self._frag_containing(rslot, ref.right_origin[1])
+                if fi is None:
+                    raise AssertionError("scheduled ref with unresolved rightOrigin")
+                right_row = self.frag_row[rslot][fi]
+                if self.row_is_gc[right_row]:
+                    degrade = True
+            if degrade:
+                self._add_row(slot, ref.clock, ref.length, None, None, True, None)
+                continue
+            row = self._add_row(
+                slot, ref.clock, ref.length, ref.origin, ref.right_origin, False, ref.content
+            )
+            plan.sched.append((row, left_row, right_row))
+            if isinstance(ref.content, ContentDeleted):
+                applicable.append((ref.client, ref.clock, ref.length))
+
+        # -- resolve delete ranges to row ids ------------------------------
+        for client, clock, ln in applicable:
+            slot = self.slot_of_client.get(client)
+            if slot is None:
+                continue
+            fc, fr = self.frag_clock[slot], self.frag_row[slot]
+            i = bisect.bisect_right(fc, clock) - 1
+            if i < 0:
+                i = 0
+            end = clock + ln
+            while i < len(fc) and fc[i] < end:
+                row = fr[i]
+                if fc[i] >= clock and not self.row_is_gc[row]:
+                    plan.delete_rows.append(row)
+                i += 1
+            self._note_deleted(slot, clock, ln)
+
+        plan.n_rows = self.n_rows
+        return plan
+
+    def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
+        ranges = self.ds.setdefault(slot, [])
+        ranges.append((clock, ln))
+
+    # -- exports ------------------------------------------------------------
+
+    def state_vector(self) -> dict[int, int]:
+        return {
+            self.client_of_slot[s]: st for s, st in enumerate(self.state) if st > 0
+        }
+
+    def origin_rows(self) -> np.ndarray:
+        """For every row, the row *containing* its origin id (NULL if no
+        origin) — the columnar get_item(store, o.origin) of the case-2
+        conflict check (reference src/structs/Item.js:447-470)."""
+        n = self.n_rows
+        out = np.full(n, NULL, np.int32)
+        oslot = np.asarray(self.row_origin_slot, np.int32)
+        oclock = np.asarray(self.row_origin_clock, np.int64)
+        for s in range(len(self.client_of_slot)):
+            mask = oslot == s
+            if not mask.any():
+                continue
+            fc = np.asarray(self.frag_clock[s], np.int64)
+            fr = np.asarray(self.frag_row[s], np.int32)
+            idx = np.searchsorted(fc, oclock[mask], side="right") - 1
+            out[np.nonzero(mask)[0]] = fr[np.clip(idx, 0, len(fr) - 1)]
+        return out
+
+    def static_columns(self) -> dict[str, np.ndarray]:
+        """The immutable device columns for the current table."""
+        return {
+            "client_key": np.asarray(
+                [self.client_of_slot[s] for s in self.row_slot], np.uint32
+            ),
+            "origin_slot": np.asarray(self.row_origin_slot, np.int32),
+            "origin_clock": np.asarray(self.row_origin_clock, np.int32),
+            "right_slot": np.asarray(self.row_right_slot, np.int32),
+            "right_clock": np.asarray(self.row_right_clock, np.int32),
+            "origin_row": self.origin_rows(),
+        }
+
+    def has_pending(self) -> bool:
+        return bool(self.pending) or bool(self.pending_ds)

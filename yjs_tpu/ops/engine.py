@@ -1,0 +1,233 @@
+"""BatchEngine: batched TPU apply_update over many docs.
+
+The device-side half of the `y-tpu` Provider described in BASELINE.json's
+north star: pending binary updates from many docs are marshalled into
+struct-of-arrays columns (:mod:`.columns`), integrated by the vmapped YATA
+kernel (:mod:`.kernels`), and the persistent device state (links, list head,
+deleted bits) lives across flushes.  Docs whose updates fall outside the
+device path's scope (nested types, map entries, subdocs) transparently fall
+back to the CPU reference core — the Provider gating seam.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly on import
+    import jax
+    import jax.numpy as jnp
+
+    HAS_JAX = True
+except Exception:  # pragma: no cover
+    HAS_JAX = False
+
+from ..core import Doc
+from ..lib0.u16 import from_u16
+from ..updates import apply_update, apply_update_v2
+from .columns import NULL, DocMirror, UnsupportedUpdate
+from . import kernels
+
+
+def _bucket(n: int, minimum: int = 64) -> int:
+    """Round up to the padding bucket (power of two) to bound recompiles."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class BatchEngine:
+    """Applies binary Yjs updates to a batch of docs on device.
+
+    Parameters
+    ----------
+    n_docs: batch size.
+    root_name: the single root list/text type the device path supports
+        (reference YText over ContentString/Format runs; everything else
+        falls back to the CPU core per doc).
+    """
+
+    def __init__(self, n_docs: int, root_name: str = "text"):
+        self.n_docs = n_docs
+        self.root_name = root_name
+        self.mirrors: list[DocMirror] = [DocMirror(root_name) for _ in range(n_docs)]
+        # CPU fallback docs (Provider gating): doc idx -> Doc
+        self.fallback: dict[int, Doc] = {}
+        self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
+        # persistent device state
+        self._cap = 0  # row capacity N (arrays are [B, N+1] with scratch row)
+        self._right = None
+        self._left = None
+        self._deleted = None
+        self._start = None
+
+    # -- update ingestion ---------------------------------------------------
+
+    def queue_update(self, doc: int, update: bytes, v2: bool = False) -> None:
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            # demoted docs apply directly; the log is dead weight for them
+            (apply_update_v2 if v2 else apply_update)(fb, update)
+        else:
+            self._update_log[doc].append((update, v2))
+            self.mirrors[doc].ingest(update, v2)
+
+    def _demote(self, doc: int) -> Doc:
+        """Move a doc to the CPU reference path by replaying its update log."""
+        fb = Doc(gc=False)
+        for update, v2 in self._update_log[doc]:
+            (apply_update_v2 if v2 else apply_update)(fb, update)
+        self.fallback[doc] = fb
+        self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
+        self._update_log[doc] = []
+        return fb
+
+    # -- device state management -------------------------------------------
+
+    def _ensure_capacity(self, n_rows: int) -> None:
+        cap = _bucket(n_rows)
+        if cap <= self._cap and self._right is not None:
+            return
+        b = self.n_docs
+        old_cap = self._cap
+        self._cap = cap
+        new_right = np.full((b, cap + 1), NULL, np.int32)
+        new_left = np.full((b, cap + 1), NULL, np.int32)
+        new_deleted = np.zeros((b, cap + 1), bool)
+        if self._right is not None:
+            # old scratch column old_cap becomes a real row slot: reset it
+            new_right[:, :old_cap] = np.asarray(self._right)[:, :old_cap]
+            new_left[:, :old_cap] = np.asarray(self._left)[:, :old_cap]
+            new_deleted[:, :old_cap] = np.asarray(self._deleted)[:, :old_cap]
+            start = np.asarray(self._start)
+        else:
+            start = np.full((b,), NULL, np.int32)
+        self._right = jnp.asarray(new_right)
+        self._left = jnp.asarray(new_left)
+        self._deleted = jnp.asarray(new_deleted)
+        self._start = jnp.asarray(start)
+
+    # -- flush: run one device integration step ----------------------------
+
+    def flush(self) -> None:
+        plans = {}
+        for i, m in enumerate(self.mirrors):
+            if i in self.fallback:
+                continue
+            try:
+                plans[i] = m.prepare_step()
+            except UnsupportedUpdate:
+                self._demote(i)
+        if not plans:
+            return
+        max_rows = max((p.n_rows for p in plans.values()), default=0)
+        self._ensure_capacity(max_rows)
+        b, cap = self.n_docs, self._cap
+
+        n_splits = _bucket(max((len(p.splits) for p in plans.values()), default=0), 1)
+        n_sched = _bucket(max((len(p.sched) for p in plans.values()), default=0), 1)
+        n_del = _bucket(max((len(p.delete_rows) for p in plans.values()), default=0), 1)
+
+        splits = np.full((b, n_splits, 2), NULL, np.int32)
+        sched = np.full((b, n_sched, 3), NULL, np.int32)
+        dels = np.full((b, n_del), NULL, np.int32)
+        statics = {
+            "client_key": np.zeros((b, cap + 1), np.uint32),
+            "origin_slot": np.full((b, cap + 1), NULL, np.int32),
+            "origin_clock": np.zeros((b, cap + 1), np.int32),
+            "right_slot": np.full((b, cap + 1), NULL, np.int32),
+            "right_clock": np.zeros((b, cap + 1), np.int32),
+            "origin_row": np.full((b, cap + 1), NULL, np.int32),
+        }
+        for i, p in plans.items():
+            m = self.mirrors[i]
+            n = m.n_rows
+            if n:
+                cols = m.static_columns()
+                for k in statics:
+                    statics[k][i, :n] = cols[k]
+            if p.splits:
+                splits[i, : len(p.splits)] = p.splits
+            if p.sched:
+                sched[i, : len(p.sched)] = p.sched
+            if p.delete_rows:
+                dels[i, : len(p.delete_rows)] = p.delete_rows
+
+        statics = {k: jnp.asarray(v) for k, v in statics.items()}
+        dyn = (self._right, self._left, self._deleted, self._start)
+        self._right, self._left, self._deleted, self._start = kernels.batch_step(
+            statics, dyn, jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels)
+        )
+
+    # -- exports ------------------------------------------------------------
+
+    def state_vector(self, doc: int) -> dict[int, int]:
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            from ..core import get_state_vector
+
+            return {c: v for c, v in get_state_vector(fb.store).items()}
+        return self.mirrors[doc].state_vector()
+
+    def _order(self, doc: int) -> tuple[np.ndarray, np.ndarray]:
+        """Document-order row ids + deleted flags for one doc."""
+        if self._left is None:
+            return np.zeros(0, np.int64), np.zeros(0, bool)
+        ranks = np.asarray(kernels.list_ranks(self._left, self._start))[doc]
+        deleted = np.asarray(self._deleted)[doc]
+        rows = np.nonzero(ranks >= 0)[0]
+        rows = rows[np.argsort(ranks[rows], kind="stable")]
+        return rows, deleted[rows]
+
+    def rows_in_order(self, doc: int) -> list[tuple[int, int, int, bool]]:
+        """(client, clock, length, deleted) per row in document order — the
+        convergence-oracle view (mirrors compare_struct_stores in tests)."""
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            out = []
+            item = fb.get_text(self.root_name)._start
+            while item is not None:
+                out.append((item.id.client, item.id.clock, item.length, item.deleted))
+                item = item.right
+            return out
+        m = self.mirrors[doc]
+        rows, dels = self._order(doc)
+        return [
+            (
+                m.client_of_slot[m.row_slot[r]],
+                m.row_clock[r],
+                m.row_len[r],
+                bool(d),
+            )
+            for r, d in zip(rows, dels)
+        ]
+
+    def text(self, doc: int) -> str:
+        """Materialize the root text content of one doc."""
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return fb.get_text(self.root_name).to_string()
+        m = self.mirrors[doc]
+        rows, dels = self._order(doc)
+        out = []
+        for r, d in zip(rows, dels):
+            if d or not m.row_countable[r]:
+                continue
+            content = m.row_content[r]
+            s = getattr(content, "str", None)
+            if s is not None:
+                out.append(s)
+            else:
+                out.append("".join(str(x) for x in getattr(content, "arr", [])))
+        # content strings are UTF-16 code units (surrogate pairs kept split
+        # across runs, reference ContentString.js:51-66); recombine like
+        # YText.to_string does
+        return from_u16("".join(out))
+
+    def has_pending(self, doc: int) -> bool:
+        if doc in self.fallback:
+            fb = self.fallback[doc]
+            return bool(fb.store.pending_clients_struct_refs) or bool(
+                fb.store.pending_delete_readers
+            )
+        return self.mirrors[doc].has_pending()

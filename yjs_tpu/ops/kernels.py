@@ -1,0 +1,242 @@
+"""JAX device kernels for the batched CRDT engine.
+
+The reference integrates one Item at a time into a pointer-chased linked list
+(reference src/structs/Item.js:403-517).  Here the same YATA semantics run as
+a ``lax.scan`` over a *static* item table (the host pre-split pass guarantees
+no splits are needed mid-kernel), vmapped over the document batch: each
+sequential scan step integrates one item in every document of the batch, so
+the TPU's parallelism is over docs while the per-doc causal chain stays
+sequential — the parallelism split called out in SURVEY.md §7 ("concurrency
+across docs (vmap)").
+
+Set semantics without sets: the reference's ``itemsBeforeOrigin`` /
+``conflictingItems`` (Item.js:447-470) only ever grow between clears, so they
+are modelled with a per-row visit counter: a row is in ``itemsBeforeOrigin``
+iff ``visit[row] >= scan_base`` and in ``conflictingItems`` iff
+``visit[row] >= clear_mark``.  No O(N) clears, O(1) membership.
+
+All row arrays carry one extra trailing scratch row (index N) that absorbs
+masked scatter writes; its contents are never read meaningfully.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NULL = -1
+
+
+def _upd(arr, idx, val, cond, dummy):
+    """Masked scatter: write ``val`` at ``idx`` when ``cond`` else write the
+    scratch row."""
+    safe_idx = jnp.where(cond, idx, dummy)
+    return arr.at[safe_idx].set(jnp.where(cond, val, arr[dummy]))
+
+
+def _ids_eq(s1, k1, s2, k2):
+    """compare_ids on (slot, clock) columns; NULL slot == null id."""
+    return (s1 == s2) & ((s1 == NULL) | (k1 == k2))
+
+
+# ---------------------------------------------------------------------------
+# per-doc step kernel (vmapped over the batch by `batch_step`)
+# ---------------------------------------------------------------------------
+
+
+def _doc_step(statics, dyn, splits, sched, delete_rows):
+    """Run one integration step for a single doc.
+
+    statics: dict of [N+1] columns (client_key u32, origin_slot/clock,
+        right_slot/clock, origin_row  i32)
+    dyn: (right_link[N+1], left_link[N+1], deleted[N+1], start  — i32/bool)
+    splits: [S, 2] i32 (orig_row, new_row), NULL-padded, right-to-left per
+        original row
+    sched: [M, 3] i32 (row, left_row, right_row), NULL-padded, causal order
+    delete_rows: [D] i32, NULL-padded
+    """
+    right_link, left_link, deleted, start = dyn
+    n1 = right_link.shape[0]
+    dummy = n1 - 1
+
+    client_key = statics["client_key"]
+    oslot = statics["origin_slot"]
+    oclock = statics["origin_clock"]
+    rslot = statics["right_slot"]
+    rclock = statics["right_clock"]
+    origin_row = statics["origin_row"]
+
+    # -- split pre-pass: link surgery for host-computed run splits ----------
+    # (the device half of splitItem, reference src/structs/Item.js:84-120)
+    def split_body(carry, instr):
+        rl, ll, dl = carry
+        orig, new = instr[0], instr[1]
+        valid = orig >= 0
+        safe_orig = jnp.where(valid, orig, dummy)
+        old_right = rl[safe_orig]
+        rl = _upd(rl, new, old_right, valid, dummy)
+        rl = _upd(rl, orig, new, valid, dummy)
+        ll = _upd(ll, new, orig, valid, dummy)
+        ll = _upd(ll, old_right, new, valid & (old_right >= 0), dummy)
+        dl = _upd(dl, new, dl[safe_orig], valid, dummy)
+        return (rl, ll, dl), None
+
+    (right_link, left_link, deleted), _ = lax.scan(
+        split_body, (right_link, left_link, deleted), splits
+    )
+
+    # -- integration scan ---------------------------------------------------
+    def integ_body(carry, s):
+        rl, ll, st, visit, counter = carry
+        k, left0, right0 = s[0], s[1], s[2]
+        valid = k >= 0
+        safe_k = jnp.where(valid, k, dummy)
+        safe_l = jnp.where(left0 >= 0, left0, dummy)
+        safe_r = jnp.where(right0 >= 0, right0, dummy)
+
+        # fast path, the negation of reference Item.js:432-434: skip the
+        # conflict scan when left is null and right is the current list head,
+        # or when left.right is still exactly right
+        skip = jnp.where(
+            left0 == NULL,
+            (right0 != NULL) & (ll[safe_r] == NULL),
+            rl[safe_l] == right0,
+        )
+
+        scan_base = counter
+        o0 = jnp.where(
+            valid & ~skip,
+            jnp.where(left0 == NULL, st, rl[safe_l]),
+            NULL,
+        )
+
+        def cond_fn(cs):
+            o, _left, _clear, _cnt, _visit, done = cs
+            return (~done) & (o != NULL) & (o != right0)
+
+        def body_fn(cs):
+            o, left, clear, cnt, visit, done = cs
+            visit = visit.at[o].set(cnt)
+            cnt = cnt + 1
+            # case 1: same origin -> lower client id goes left
+            same_origin = _ids_eq(oslot[safe_k], oclock[safe_k], oslot[o], oclock[o])
+            c1_left = same_origin & (client_key[o] < client_key[safe_k])
+            c1_break = same_origin & ~c1_left & _ids_eq(
+                rslot[safe_k], rclock[safe_k], rslot[o], rclock[o]
+            )
+            # case 2: o's origin lies between this.origin and this
+            orow = origin_row[o]
+            has_origin = oslot[o] != NULL
+            safe_orow = jnp.where(has_origin, orow, dummy)
+            in_before = has_origin & (visit[safe_orow] >= scan_base)
+            c2 = ~same_origin & in_before
+            c2_left = c2 & ~(visit[safe_orow] >= clear)
+            # case 3: unrelated item -> done
+            c3_break = ~same_origin & ~in_before
+            take_left = c1_left | c2_left
+            left = jnp.where(take_left, o, left)
+            clear = jnp.where(take_left, cnt, clear)
+            done = c1_break | c3_break
+            o = jnp.where(done, o, rl[o])
+            return (o, left, clear, cnt, visit, done)
+
+        o, left, _clear, counter, visit, _done = lax.while_loop(
+            cond_fn, body_fn, (o0, left0, scan_base, counter, visit, False)
+        )
+
+        # splice into the list (reference Item.js:473-489, list path)
+        safe_left = jnp.where(left >= 0, left, dummy)
+        right2 = jnp.where(left == NULL, st, rl[safe_left])
+        rl = _upd(rl, left, k, valid & (left != NULL), dummy)
+        st = jnp.where(valid & (left == NULL), k, st)
+        rl = _upd(rl, k, right2, valid, dummy)
+        ll = _upd(ll, k, left, valid, dummy)
+        ll = _upd(ll, right2, k, valid & (right2 != NULL), dummy)
+        return (rl, ll, st, visit, counter), None
+
+    visit0 = jnp.full((n1,), -1, jnp.int32)
+    (right_link, left_link, start, _visit, _counter), _ = lax.scan(
+        integ_body, (right_link, left_link, start, visit0, jnp.int32(0)), sched
+    )
+
+    # -- delete marking (reference DeleteSet.js readAndApplyDeleteSet tail) -
+    valid_d = delete_rows >= 0
+    deleted = deleted.at[jnp.where(valid_d, delete_rows, dummy)].set(
+        jnp.where(valid_d, True, deleted[dummy])
+    )
+
+    return right_link, left_link, deleted, start
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def batch_step(statics, dyn, splits, sched, delete_rows):
+    """vmapped integration step over the doc batch.
+
+    All arguments are dicts/tuples of arrays with a leading doc axis [B, ...].
+    """
+    return jax.vmap(_doc_step)(statics, dyn, splits, sched, delete_rows)
+
+
+# ---------------------------------------------------------------------------
+# export / sync kernels
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def list_ranks(left_link, start):
+    """List ranking by pointer doubling: rank[i] = #predecessors of row i in
+    its doc's linked list; invalid rows get rank -1.
+
+    left_link: [B, N+1] i32, start: [B] i32.  log2(N) rounds of gathers —
+    the parallel-prefix replacement for walking `right` pointers.
+    """
+    b, n1 = left_link.shape
+    idx = jnp.arange(n1, dtype=jnp.int32)[None, :]
+    in_list = (left_link != NULL) | (idx == start[:, None])
+    in_list = in_list & (idx != n1 - 1)  # scratch row is never real
+    d = jnp.where(left_link != NULL, 1, 0).astype(jnp.int32)
+    p = jnp.where(in_list, left_link, NULL)
+    n_rounds = max(1, math.ceil(math.log2(max(2, n1))))
+    for _ in range(n_rounds):
+        safe_p = jnp.where(p != NULL, p, 0)
+        d = d + jnp.where(p != NULL, jnp.take_along_axis(d, safe_p, axis=1), 0)
+        p = jnp.where(p != NULL, jnp.take_along_axis(p, safe_p, axis=1), NULL)
+    return jnp.where(in_list, d, NULL)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def state_vector_kernel(row_slot, row_end, n_slots):
+    """Dense per-doc state vectors: sv[b, slot] = max(clock+len) over rows —
+    the segment-max recast of getStateVector (StructStore.js:49-56).
+
+    row_slot: [B, N] i32 (NULL for unused rows), row_end: [B, N] i32.
+    """
+    seg = jnp.where(row_slot >= 0, row_slot, n_slots)
+    f = jax.vmap(
+        lambda s, e: jax.ops.segment_max(
+            e, s, num_segments=n_slots + 1, indices_are_sorted=False
+        )
+    )
+    sv = f(seg, row_end)
+    sv = jnp.maximum(sv, 0)
+    return sv[:, :n_slots]
+
+
+@jax.jit
+def diff_mask_kernel(row_slot, row_clock, row_end, sv):
+    """Rows (or row suffixes) missing from a remote state vector: the
+    columnar filter of writeClientsStructs (encoding.js:94-116).
+
+    Returns (needed[B,N] bool, offset[B,N] i32): offset>0 means the row must
+    be written from that element offset (the partial-first-struct rule,
+    encoding.js:71-84).
+    """
+    safe_slot = jnp.where(row_slot >= 0, row_slot, 0)
+    remote = jnp.take_along_axis(sv, safe_slot, axis=1)
+    needed = (row_slot >= 0) & (row_end > remote)
+    offset = jnp.clip(remote - row_clock, 0, None)
+    return needed, jnp.where(needed, offset, 0)

@@ -51,6 +51,16 @@ def _or_null(v):
     return None if _js_falsy(v) else v
 
 
+def _js_strict_eq(a, b) -> bool:
+    """JS `===`: identity for objects/arrays, value equality for primitives
+    (bool and number are distinct JS types)."""
+    if isinstance(a, (dict, list)) or isinstance(b, (dict, list)):
+        return a is b
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    return a == b
+
+
 def equal_attrs(a, b) -> bool:
     """JS `===` or flat object equality (reference YText.js:41)."""
     if a is b:
@@ -297,9 +307,13 @@ def cleanup_formatting_gap(transaction, start, end, start_attributes: dict, end_
         if not start.deleted:
             content = start.content
             if type(content) is ContentFormat:
-                if _or_null(end_attributes.get(content.key)) != content.value or _or_null(
-                    start_attributes.get(content.key)
-                ) == content.value:
+                # the reference compares with JS === here (identity for
+                # objects), not deep equality (YText.js:362)
+                if not _js_strict_eq(
+                    _or_null(end_attributes.get(content.key)), content.value
+                ) or _js_strict_eq(
+                    _or_null(start_attributes.get(content.key)), content.value
+                ):
                     start.delete(transaction)
                     cleanups += 1
         start = start.right

@@ -62,10 +62,12 @@ class YXmlTreeWalker:
         n = self._current_node
         if n is None:
             raise StopIteration
-        type_ = n.content.type
+        # gc'd children carry ContentDeleted with no .type; the reference's
+        # short-circuit on n.deleted tolerates the undefined read
+        type_ = getattr(n.content, "type", None)
         if not self._first_call or n.deleted or not self._filter(type_):
             while True:
-                type_ = n.content.type
+                type_ = getattr(n.content, "type", None)
                 if (
                     not n.deleted
                     and (type(type_) is YXmlElement or type(type_) is YXmlFragment)
