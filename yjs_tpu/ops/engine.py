@@ -28,6 +28,26 @@ from .columns import NULL, DocMirror, UnsupportedUpdate
 from . import kernels
 
 
+def visible_text(mirror, rows, deleted) -> str:
+    """Materialize visible text from document-ordered rows + deleted flags.
+
+    Content strings are UTF-16 code units (surrogate pairs may be split
+    across runs, reference ContentString.js:51-66); recombine like
+    YText.to_string does.  Shared by BatchEngine.text and bench.py.
+    """
+    out = []
+    for r, d in zip(rows, deleted):
+        if d or not mirror.row_countable[r]:
+            continue
+        content = mirror.row_content[r]
+        s = getattr(content, "str", None)
+        if s is not None:
+            out.append(s)
+        else:
+            out.append("".join(str(x) for x in getattr(content, "arr", [])))
+    return from_u16("".join(out))
+
+
 def _bucket(n: int, minimum: int = 64) -> int:
     """Round up to the padding bucket (power of two) to bound recompiles."""
     b = minimum
@@ -47,9 +67,23 @@ class BatchEngine:
         falls back to the CPU core per doc).
     """
 
-    def __init__(self, n_docs: int, root_name: str = "text"):
+    def __init__(self, n_docs: int, root_name: str = "text", mesh=None):
         self.n_docs = n_docs
         self.root_name = root_name
+        self.mesh = mesh
+        self._metrics_dev: dict | None = None
+        self._sharded_step = None
+        if mesh is not None:
+            doc_axis = mesh.axis_names[0]
+            axis_size = mesh.shape[doc_axis]
+            if n_docs % axis_size != 0:
+                raise ValueError(
+                    f"n_docs={n_docs} must be a multiple of the {doc_axis!r} "
+                    f"axis size {axis_size}"
+                )
+            from ..parallel.mesh import sharded_batch_step
+
+            self._sharded_step = sharded_batch_step(mesh, doc_axis)
         self.mirrors: list[DocMirror] = [DocMirror(root_name) for _ in range(n_docs)]
         # CPU fallback docs (Provider gating): doc idx -> Doc
         self.fallback: dict[int, Doc] = {}
@@ -155,9 +189,21 @@ class BatchEngine:
 
         statics = {k: jnp.asarray(v) for k, v in statics.items()}
         dyn = (self._right, self._left, self._deleted, self._start)
-        self._right, self._left, self._deleted, self._start = kernels.batch_step(
-            statics, dyn, jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels)
-        )
+        args = (statics, dyn, jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels))
+        if self._sharded_step is not None:
+            # keep metrics as device scalars: converting here would block the
+            # async dispatch and serialize host transcode with device compute
+            new_dyn, self._metrics_dev = self._sharded_step(*args)
+        else:
+            new_dyn = kernels.batch_step(*args)
+        self._right, self._left, self._deleted, self._start = new_dyn
+
+    @property
+    def last_metrics(self) -> dict | None:
+        """Global psum'd counters from the last sharded flush (syncs)."""
+        if self._metrics_dev is None:
+            return None
+        return {k: int(v) for k, v in self._metrics_dev.items()}
 
     # -- exports ------------------------------------------------------------
 
@@ -207,22 +253,8 @@ class BatchEngine:
         fb = self.fallback.get(doc)
         if fb is not None:
             return fb.get_text(self.root_name).to_string()
-        m = self.mirrors[doc]
         rows, dels = self._order(doc)
-        out = []
-        for r, d in zip(rows, dels):
-            if d or not m.row_countable[r]:
-                continue
-            content = m.row_content[r]
-            s = getattr(content, "str", None)
-            if s is not None:
-                out.append(s)
-            else:
-                out.append("".join(str(x) for x in getattr(content, "arr", [])))
-        # content strings are UTF-16 code units (surrogate pairs kept split
-        # across runs, reference ContentString.js:51-66); recombine like
-        # YText.to_string does
-        return from_u16("".join(out))
+        return visible_text(self.mirrors[doc], rows, dels)
 
     def has_pending(self, doc: int) -> bool:
         if doc in self.fallback:

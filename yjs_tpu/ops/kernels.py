@@ -145,7 +145,16 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
             return (o, left, clear, cnt, visit, done)
 
         o, left, _clear, counter, visit, _done = lax.while_loop(
-            cond_fn, body_fn, (o0, left0, scan_base, counter, visit, False)
+            cond_fn,
+            body_fn,
+            (
+                o0.astype(jnp.int32),
+                left0.astype(jnp.int32),
+                scan_base.astype(jnp.int32),
+                counter.astype(jnp.int32),
+                visit,
+                jnp.bool_(False),
+            ),
         )
 
         # splice into the list (reference Item.js:473-489, list path)

@@ -1,0 +1,3 @@
+"""Device-mesh parallelism: shard the doc batch across TPU cores."""
+
+from .mesh import doc_mesh, sharded_batch_step, sharded_state_vectors  # noqa: F401

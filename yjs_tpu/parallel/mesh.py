@@ -1,0 +1,119 @@
+"""Mesh + shard_map wrappers for the batch engine.
+
+The reference has no cross-process parallelism — docs are independent, so the
+TPU-native scaling story (SURVEY.md §2 parallelism table) is: shard the *doc
+batch* axis across the device mesh with ``shard_map``; ICI collectives are
+used for global metrics and state-vector gathers, not for integration itself
+(no cross-doc communication exists to translate).
+
+Axes:
+- ``docs``: the data-parallel axis — every [B, ...] array is sharded on its
+  leading dim.
+- ``rows`` (optional, 2D mesh): a sequence-parallel-style axis over the item
+  table for reduction kernels (state vectors via per-shard segment-max +
+  ``pmax``), the long-document analogue of sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8: VMA checking is on by default; our kernels create
+    # unvarying intermediates inside the mapped fn, so disable it
+    from jax import shard_map as _shard_map_impl
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+from ..ops import kernels
+
+
+def doc_mesh(
+    n_devices: int | None = None, axis: str = "docs", backend: str | None = None
+) -> Mesh:
+    """A 1-D mesh over the doc-batch axis.
+
+    ``backend='cpu'`` builds the virtual host mesh (with
+    ``--xla_force_host_platform_device_count=N``) even when a real
+    accelerator is the default platform — the multi-chip dry-run path.
+    """
+    devs = jax.devices(backend) if backend else jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, backend has {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis,))
+
+
+def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
+    """The engine step sharded over the doc axis.
+
+    Returns a jitted fn with the same signature as
+    :func:`yjs_tpu.ops.kernels.batch_step` plus a replicated metrics dict
+    (psum over ICI) so every host sees global progress counters.
+    """
+    spec = P(axis)
+
+    def local_step(statics, dyn, splits, sched, delete_rows):
+        out = jax.vmap(kernels._doc_step)(statics, dyn, splits, sched, delete_rows)
+        integrated = jnp.sum(sched[..., 0] >= 0)
+        deleted = jnp.sum(delete_rows >= 0)
+        metrics = {
+            "integrated": lax.psum(integrated, axis),
+            "deleted": lax.psum(deleted, axis),
+        }
+        return out, metrics
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=((spec, spec, spec, spec), P()),
+    )
+    # donate the persistent dyn buffers like kernels.batch_step does
+    return jax.jit(sharded, donate_argnums=(1,))
+
+
+def sharded_state_vectors(mesh: Mesh, n_slots: int, axis: str = "docs", row_axis: str | None = None):
+    """State vectors over a sharded doc batch; with a 2-D mesh the item-table
+    axis is also sharded and reduced with pmax over ICI (the segment-max of
+    StructStore.getStateVector, reference StructStore.js:49-56)."""
+
+    def local_sv(row_slot, row_end):
+        sv = kernels.state_vector_kernel(row_slot, row_end, n_slots)
+        if row_axis is not None:
+            sv = lax.pmax(sv, row_axis)
+        return sv
+
+    if row_axis is None:
+        in_spec = P(axis)
+        out_spec = P(axis)
+    else:
+        in_spec = P(axis, row_axis)
+        out_spec = P(axis)
+    return jax.jit(
+        shard_map(
+            local_sv,
+            mesh=mesh,
+            in_specs=(in_spec, in_spec),
+            out_specs=out_spec,
+        )
+    )
