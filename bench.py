@@ -93,6 +93,11 @@ def main():
     cpu_time = time.perf_counter() - t0
     sv = Y.decode_state_vector(Y.encode_state_vector(cpu_doc))
     n_elements = sum(sv.values())
+    if n_elements == 0:
+        print(json.dumps({"metric": "batched_apply_update_elements_per_sec",
+                          "value": 0, "unit": "elem/s (empty workload)",
+                          "vs_baseline": 0}))
+        return
     cpu_rate = n_elements / cpu_time
 
     # ---- host transcode (once) + broadcast across the doc batch ------------
@@ -118,9 +123,11 @@ def main():
         "right_clock": pad_col("right_clock", 0, np.int32),
         "origin_row": pad_col("origin_row", NULL, np.int32),
     }
-    sched = np.broadcast_to(
-        np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 3)
-    )
+    sched = np.full((n_docs, 1, 3), NULL, np.int32)
+    if plan.sched:
+        sched = np.broadcast_to(
+            np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 3)
+        )
     splits = np.full((n_docs, 1, 2), NULL, np.int32)
     if plan.splits:
         splits = np.broadcast_to(

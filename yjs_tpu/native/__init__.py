@@ -1,0 +1,110 @@
+"""ctypes loader for the native V1 transcoder (transcode.cpp).
+
+Builds lazily with g++ on first use (cached as _transcode.so next to the
+source); silently unavailable when no toolchain exists or YTPU_NO_NATIVE is
+set — callers fall back to the pure-Python decoder.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "transcode.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_transcode.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """The loaded library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("YTPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ytpu_count_v1.restype = ctypes.c_int
+    lib.ytpu_count_v1.argtypes = [u8p, ctypes.c_uint64, u64p, u64p]
+    lib.ytpu_decode_v1.restype = ctypes.c_int
+    lib.ytpu_decode_v1.argtypes = [u8p, ctypes.c_uint64] + [i64p] * 19
+    _lib = lib
+    return _lib
+
+
+class NativeDecodeError(Exception):
+    pass
+
+
+def decode_v1_columns(update: bytes):
+    """Decode a V1 update into int64 column arrays via the native scanner.
+
+    Returns (structs: dict[str, np.ndarray], ds: dict[str, np.ndarray]).
+    Raises NativeDecodeError if the library is unavailable or parsing fails
+    (caller falls back to the Python decoder).
+    """
+    lib = load()
+    if lib is None:
+        raise NativeDecodeError("native transcoder unavailable")
+    buf = np.frombuffer(update, dtype=np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    n_structs = ctypes.c_uint64()
+    n_ds = ctypes.c_uint64()
+    rc = lib.ytpu_count_v1(bp, len(update), ctypes.byref(n_structs), ctypes.byref(n_ds))
+    if rc != 0:
+        raise NativeDecodeError(f"count pass failed: {rc}")
+    ns, nd = n_structs.value, n_ds.value
+    cols = {
+        k: np.empty(ns, np.int64)
+        for k in (
+            "client", "clock", "length",
+            "origin_client", "origin_clock", "right_client", "right_clock",
+            "info", "parent_name_ofs", "parent_name_len",
+            "parent_id_client", "parent_id_clock",
+            "parent_sub_ofs", "parent_sub_len", "content_ofs", "content_end",
+        )
+    }
+    ds = {k: np.empty(nd, np.int64) for k in ("client", "clock", "len")}
+    ptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    rc = lib.ytpu_decode_v1(
+        bp, len(update),
+        ptr(cols["client"]), ptr(cols["clock"]), ptr(cols["length"]),
+        ptr(cols["origin_client"]), ptr(cols["origin_clock"]),
+        ptr(cols["right_client"]), ptr(cols["right_clock"]),
+        ptr(cols["info"]),
+        ptr(cols["parent_name_ofs"]), ptr(cols["parent_name_len"]),
+        ptr(cols["parent_id_client"]), ptr(cols["parent_id_clock"]),
+        ptr(cols["parent_sub_ofs"]), ptr(cols["parent_sub_len"]),
+        ptr(cols["content_ofs"]), ptr(cols["content_end"]),
+        ptr(ds["client"]), ptr(ds["clock"]), ptr(ds["len"]),
+    )
+    if rc != 0:
+        raise NativeDecodeError(f"decode pass failed: {rc}")
+    return cols, ds

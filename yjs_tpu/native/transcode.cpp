@@ -1,0 +1,294 @@
+// Native columnar transcoder for the Yjs V1 wire format.
+//
+// The host-side decode of update blobs (reference src/utils/encoding.js
+// readClientsStructRefs, encoding.js:127-198, and the DS section of
+// DeleteSet.js:270-285) is the per-item hot loop of the marshaling pipeline
+// feeding the TPU batch engine (SURVEY.md §7 phase 1: "the only candidate
+// for a C++ component — varint/RLE transcode at 100k-doc scale").  This
+// library scans an update once and emits fixed-width columns; variable
+// payloads stay in the source buffer, referenced by byte ranges, and are
+// decoded lazily by the Python side only when materialized.
+//
+// Two-pass C ABI: ytpu_count_v1 sizes the outputs, ytpu_decode_v1 fills
+// caller-allocated arrays.  All columns are int64 with -1 as the null
+// sentinel.  Returns 0 on success, a negative error code otherwise.
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+struct Reader {
+  const uint8_t* buf;
+  uint64_t len;
+  uint64_t pos;
+  bool fail;
+
+  uint8_t u8() {
+    if (pos >= len) { fail = true; return 0; }
+    return buf[pos++];
+  }
+
+  // lib0 varuint (7 bits per byte, little-endian groups)
+  uint64_t varuint() {
+    uint64_t num = 0;
+    int shift = 0;
+    while (true) {
+      if (pos >= len || shift > 63) { fail = true; return 0; }
+      uint8_t r = buf[pos++];
+      num |= (uint64_t)(r & 0x7f) << shift;
+      shift += 7;
+      if (r < 0x80) return num;
+    }
+  }
+
+  // lib0 varint: first byte holds sign bit 0x40 and 6 bits of payload
+  void varint() {
+    if (pos >= len) { fail = true; return; }
+    uint8_t r = buf[pos++];
+    if (r < 0x80) return;
+    int shift = 6;
+    while (true) {
+      if (pos >= len || shift > 63) { fail = true; return; }
+      uint8_t c = buf[pos++];
+      shift += 7;
+      if (c < 0x80) return;
+    }
+  }
+
+  void skip(uint64_t n) {
+    if (n > len - pos) { fail = true; return; }  // overflow-safe bound check
+    pos += n;
+  }
+
+  // var_string: varuint byte length + utf8; returns (ofs, bytelen)
+  void var_string(uint64_t* ofs, uint64_t* blen) {
+    uint64_t n = varuint();
+    *ofs = pos;
+    *blen = n;
+    skip(n);
+  }
+
+  // UTF-16 code-unit count of a utf8 range (JS string .length semantics)
+  uint64_t utf16_len(uint64_t ofs, uint64_t blen) const {
+    uint64_t units = 0;
+    for (uint64_t i = ofs; i < ofs + blen && i < len; ) {
+      uint8_t b = buf[i];
+      if (b < 0x80) { units += 1; i += 1; }
+      else if (b < 0xE0) { units += 1; i += 2; }
+      else if (b < 0xF0) { units += 1; i += 3; }
+      else { units += 2; i += 4; }
+    }
+    return units;
+  }
+
+  // skip one lib0 "any" value
+  void skip_any(int depth = 0) {
+    if (depth > 64) { fail = true; return; }
+    uint8_t tag = u8();
+    if (fail) return;
+    switch (tag) {
+      case 127: case 126: case 121: case 120: break;  // undefined/null/bools
+      case 125: varint(); break;
+      case 124: skip(4); break;                        // float32
+      case 123: skip(8); break;                        // float64
+      case 122: skip(8); break;                        // bigint64
+      case 119: { uint64_t o, b; var_string(&o, &b); break; }
+      case 118: {                                      // object
+        uint64_t n = varuint();
+        for (uint64_t i = 0; i < n && !fail; i++) {
+          uint64_t o, b; var_string(&o, &b);
+          skip_any(depth + 1);
+        }
+        break;
+      }
+      case 117: {                                      // array
+        uint64_t n = varuint();
+        for (uint64_t i = 0; i < n && !fail; i++) skip_any(depth + 1);
+        break;
+      }
+      case 116: { uint64_t n = varuint(); skip(n); break; }  // uint8array
+      default: fail = true;
+    }
+  }
+};
+
+constexpr uint8_t kBit6 = 0x20, kBit7 = 0x40, kBit8 = 0x80, kBits5 = 0x1f;
+
+struct StructOut {
+  int64_t *client, *clock, *length;
+  int64_t *origin_client, *origin_clock;
+  int64_t *right_client, *right_clock;
+  int64_t *info;
+  int64_t *parent_name_ofs, *parent_name_len;
+  int64_t *parent_id_client, *parent_id_clock;
+  int64_t *parent_sub_ofs, *parent_sub_len;
+  int64_t *content_ofs, *content_end;
+};
+
+// Parse the struct section.  When out == nullptr, only counts.
+// Returns the number of structs, or sets r->fail.
+uint64_t parse_structs(Reader* r, StructOut* out) {
+  uint64_t idx = 0;
+  uint64_t n_updates = r->varuint();
+  for (uint64_t u = 0; u < n_updates && !r->fail; u++) {
+    uint64_t n_structs = r->varuint();
+    uint64_t client = r->varuint();
+    uint64_t clock = r->varuint();
+    for (uint64_t s = 0; s < n_structs && !r->fail; s++) {
+      uint8_t info = r->u8();
+      uint8_t ref = info & kBits5;
+      int64_t oc = -1, ok = 0, rc = -1, rk = 0;
+      int64_t pno = -1, pnl = -1, pic = -1, pik = -1, pso = -1, psl = -1;
+      uint64_t length = 0, c_ofs = 0, c_end = 0;
+      if (ref != 0) {
+        if (info & kBit8) { oc = (int64_t)r->varuint(); ok = (int64_t)r->varuint(); }
+        if (info & kBit7) { rc = (int64_t)r->varuint(); rk = (int64_t)r->varuint(); }
+        if (!(info & (kBit7 | kBit8))) {
+          if (r->varuint() == 1) {                       // parent is root name
+            uint64_t o, b; r->var_string(&o, &b);
+            pno = (int64_t)o; pnl = (int64_t)b;
+          } else {                                       // parent is an id
+            pic = (int64_t)r->varuint(); pik = (int64_t)r->varuint();
+          }
+          if (info & kBit6) {
+            uint64_t o, b; r->var_string(&o, &b);
+            pso = (int64_t)o; psl = (int64_t)b;
+          }
+        }
+        c_ofs = r->pos;
+        switch (ref) {
+          case 1: length = r->varuint(); break;          // ContentDeleted
+          case 2: {                                      // ContentJSON
+            uint64_t n = r->varuint();
+            for (uint64_t i = 0; i < n && !r->fail; i++) {
+              uint64_t o, b; r->var_string(&o, &b);
+            }
+            length = n;
+            break;
+          }
+          case 3: { uint64_t n = r->varuint(); r->skip(n); length = 1; break; }
+          case 4: {                                      // ContentString
+            uint64_t o, b; r->var_string(&o, &b);
+            length = r->utf16_len(o, b);
+            break;
+          }
+          case 5: {                                      // ContentEmbed (json string)
+            uint64_t o, b; r->var_string(&o, &b);
+            length = 1;
+            break;
+          }
+          case 6: {                                      // ContentFormat
+            uint64_t o, b;
+            r->var_string(&o, &b);                       // key
+            r->var_string(&o, &b);                       // json value
+            length = 1;
+            break;
+          }
+          case 7: {                                      // ContentType
+            uint64_t tref = r->varuint();
+            if (tref == 3 || tref == 5) {                // XmlElement / XmlHook
+              uint64_t o, b; r->var_string(&o, &b);
+            }
+            length = 1;
+            break;
+          }
+          case 8: {                                      // ContentAny
+            uint64_t n = r->varuint();
+            for (uint64_t i = 0; i < n && !r->fail; i++) r->skip_any();
+            length = n;
+            break;
+          }
+          case 9: {                                      // ContentDoc
+            uint64_t o, b; r->var_string(&o, &b);        // guid
+            r->skip_any();                               // opts
+            length = 1;
+            break;
+          }
+          default: r->fail = true;
+        }
+        c_end = r->pos;
+      } else {
+        length = r->varuint();                           // GC
+      }
+      if (r->fail) break;
+      if (length == 0 && ref != 0) { r->fail = true; break; }
+      if (out != nullptr) {
+        out->client[idx] = (int64_t)client;
+        out->clock[idx] = (int64_t)clock;
+        out->length[idx] = (int64_t)length;
+        out->origin_client[idx] = oc; out->origin_clock[idx] = ok;
+        out->right_client[idx] = rc; out->right_clock[idx] = rk;
+        out->info[idx] = info;
+        out->parent_name_ofs[idx] = pno; out->parent_name_len[idx] = pnl;
+        out->parent_id_client[idx] = pic; out->parent_id_clock[idx] = pik;
+        out->parent_sub_ofs[idx] = pso; out->parent_sub_len[idx] = psl;
+        out->content_ofs[idx] = (int64_t)c_ofs; out->content_end[idx] = (int64_t)c_end;
+      }
+      idx++;
+      clock += length;
+    }
+  }
+  return idx;
+}
+
+uint64_t parse_ds(Reader* r, int64_t* ds_client, int64_t* ds_clock, int64_t* ds_len) {
+  uint64_t idx = 0;
+  uint64_t n_clients = r->varuint();
+  for (uint64_t c = 0; c < n_clients && !r->fail; c++) {
+    uint64_t client = r->varuint();
+    uint64_t n = r->varuint();
+    for (uint64_t i = 0; i < n && !r->fail; i++) {
+      uint64_t clock = r->varuint();
+      uint64_t len = r->varuint();
+      if (ds_client != nullptr) {
+        ds_client[idx] = (int64_t)client;
+        ds_clock[idx] = (int64_t)clock;
+        ds_len[idx] = (int64_t)len;
+      }
+      idx++;
+    }
+  }
+  return idx;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ytpu_count_v1(const uint8_t* buf, uint64_t len,
+                  uint64_t* n_structs, uint64_t* n_ds) {
+  Reader r{buf, len, 0, false};
+  *n_structs = parse_structs(&r, nullptr);
+  if (r.fail) return -1;
+  *n_ds = parse_ds(&r, nullptr, nullptr, nullptr);
+  if (r.fail) return -2;
+  if (r.pos != len) return -3;  // trailing garbage
+  return 0;
+}
+
+int ytpu_decode_v1(const uint8_t* buf, uint64_t len,
+                   int64_t* client, int64_t* clock, int64_t* length,
+                   int64_t* origin_client, int64_t* origin_clock,
+                   int64_t* right_client, int64_t* right_clock,
+                   int64_t* info,
+                   int64_t* parent_name_ofs, int64_t* parent_name_len,
+                   int64_t* parent_id_client, int64_t* parent_id_clock,
+                   int64_t* parent_sub_ofs, int64_t* parent_sub_len,
+                   int64_t* content_ofs, int64_t* content_end,
+                   int64_t* ds_client, int64_t* ds_clock, int64_t* ds_len) {
+  Reader r{buf, len, 0, false};
+  StructOut out{client, clock, length, origin_client, origin_clock,
+                right_client, right_clock, info,
+                parent_name_ofs, parent_name_len,
+                parent_id_client, parent_id_clock,
+                parent_sub_ofs, parent_sub_len,
+                content_ofs, content_end};
+  parse_structs(&r, &out);
+  if (r.fail) return -1;
+  parse_ds(&r, ds_client, ds_clock, ds_len);
+  if (r.fail) return -2;
+  return 0;
+}
+
+}  // extern "C"

@@ -36,13 +36,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..coding import UpdateDecoderV1, UpdateDecoderV2
-from ..core import (
-    GC,
-    ContentDeleted,
-    ContentDoc,
-    ContentType,
-    read_item_content,
-)
+from ..core import read_item_content
 from ..lib0 import decoding
 from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
@@ -55,7 +49,27 @@ NULL = -1  # null id / null row sentinel in every int column
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+class LazyContent:
+    """Content payload referenced by byte range, decoded only on demand.
+
+    The native transcoder (yjs_tpu/native) emits byte offsets instead of
+    decoding payloads; most rows are never materialized (state vectors,
+    diffs, integration itself need no payload bytes)."""
+
+    __slots__ = ("buf", "ofs", "ref")
+
+    def __init__(self, buf: bytes, ofs: int, ref: int):
+        self.buf = buf
+        self.ofs = ofs
+        self.ref = ref
+
+    def realize(self):
+        decoder = Decoder(self.buf)
+        decoder.pos = self.ofs
+        return read_item_content(UpdateDecoderV1(decoder), self.ref)
+
+
+@dataclass(slots=True)
 class ItemRef:
     """A decoded, not-yet-integrated struct (Item or GC) as plain data."""
 
@@ -67,13 +81,19 @@ class ItemRef:
     parent_name: str | None = None  # root-type key
     parent_id: tuple[int, int] | None = None  # nested parent (CPU-only path)
     parent_sub: str | None = None
-    content: object | None = None  # AbstractContent; None for GC refs
+    content: object | None = None  # AbstractContent | LazyContent; None = GC
+    content_ref: int = 0  # wire content-ref (0 = GC struct)
     is_gc: bool = False
+
+    def materialize(self):
+        if isinstance(self.content, LazyContent):
+            self.content = self.content.realize()
+        return self.content
 
     def split(self, offset: int) -> "ItemRef":
         """Split off and return the right part at ``offset`` elements
         (reference src/structs/Item.js:84-120 field rules)."""
-        right_content = self.content.splice(offset)
+        right_content = self.materialize().splice(offset)
         right = ItemRef(
             client=self.client,
             clock=self.clock + offset,
@@ -84,6 +104,7 @@ class ItemRef:
             parent_id=self.parent_id,
             parent_sub=self.parent_sub,
             content=right_content,
+            content_ref=self.content_ref,
         )
         self.length = offset
         return right
@@ -93,7 +114,7 @@ class ItemRef:
         `offset` path of reference src/structs/Item.js:745-755 and
         GC.js integrate)."""
         if self.content is not None:
-            self.content = self.content.splice(offset)
+            self.content = self.materialize().splice(offset)
         self.clock += offset
         self.length -= offset
         if not self.is_gc:
@@ -105,8 +126,14 @@ def decode_update_refs(update: bytes, v2: bool):
 
     Mirrors reference src/utils/encoding.js:127-198 (struct section) and
     src/utils/DeleteSet.js:270-285 (DS section header/ranges), but resolves
-    nothing — root parents stay names, origins stay IDs.
+    nothing — root parents stay names, origins stay IDs.  V1 updates take
+    the native columnar scanner when available (payloads stay lazy).
     """
+    if not v2:
+        try:
+            return _decode_update_refs_native(update)
+        except Exception:
+            pass  # fall back to the pure-Python decoder
     decoder = Decoder(update)
     yd = UpdateDecoderV2(decoder) if v2 else UpdateDecoderV1(decoder)
     refs: dict[int, list[ItemRef]] = {}
@@ -148,6 +175,7 @@ def decode_update_refs(update: bytes, v2: bool):
                     parent_id=parent_id,
                     parent_sub=parent_sub,
                     content=content,
+                    content_ref=info & BITS5,
                 )
                 out.append(ref)
                 clock += ref.length
@@ -165,6 +193,57 @@ def decode_update_refs(update: bytes, v2: bool):
         num_deletes = decoding.read_var_uint(yd.rest_decoder)
         for _ in range(num_deletes):
             ds.append((client, yd.read_ds_clock(), yd.read_ds_len()))
+    return refs, ds
+
+
+def _decode_update_refs_native(update: bytes):
+    """Build ItemRefs from the native scanner's columns (V1 only)."""
+    from ..lib0.u16 import utf8_decode_u16
+    from ..native import decode_v1_columns
+
+    cols, ds_cols = decode_v1_columns(update)
+    refs: dict[int, list[ItemRef]] = {}
+    n = len(cols["client"])
+    client_a = cols["client"]
+    clock_a = cols["clock"]
+    length_a = cols["length"]
+    oc, ok = cols["origin_client"], cols["origin_clock"]
+    rc, rk = cols["right_client"], cols["right_clock"]
+    info_a = cols["info"]
+    pno, pnl = cols["parent_name_ofs"], cols["parent_name_len"]
+    pic, pik = cols["parent_id_client"], cols["parent_id_clock"]
+    pso, psl = cols["parent_sub_ofs"], cols["parent_sub_len"]
+    c_ofs = cols["content_ofs"]
+    for i in range(n):
+        client = int(client_a[i])
+        ref_kind = int(info_a[i]) & BITS5
+        if ref_kind == 0:
+            ref = ItemRef(
+                client=client, clock=int(clock_a[i]), length=int(length_a[i]),
+                is_gc=True,
+            )
+        else:
+            ref = ItemRef(
+                client=client,
+                clock=int(clock_a[i]),
+                length=int(length_a[i]),
+                origin=None if oc[i] < 0 else (int(oc[i]), int(ok[i])),
+                right_origin=None if rc[i] < 0 else (int(rc[i]), int(rk[i])),
+                parent_name=None
+                if pno[i] < 0
+                else utf8_decode_u16(update[int(pno[i]) : int(pno[i]) + int(pnl[i])]),
+                parent_id=None if pic[i] < 0 else (int(pic[i]), int(pik[i])),
+                parent_sub=None
+                if pso[i] < 0
+                else utf8_decode_u16(update[int(pso[i]) : int(pso[i]) + int(psl[i])]),
+                content=LazyContent(update, int(c_ofs[i]), int(info_a[i])),
+                content_ref=ref_kind,
+            )
+        refs.setdefault(client, []).append(ref)
+    ds = [
+        (int(c), int(k), int(ln))
+        for c, k, ln in zip(ds_cols["client"], ds_cols["clock"], ds_cols["len"])
+    ]
     return refs, ds
 
 
@@ -223,6 +302,7 @@ class DocMirror:
         self.row_is_gc: list[bool] = []
         self.row_countable: list[bool] = []
         self.row_content: list[object | None] = []
+        self.row_content_ref: list[int] = []
         # per-slot fragment index, sorted by clock
         self.frag_clock: list[list[int]] = []
         self.frag_row: list[list[int]] = []
@@ -261,7 +341,8 @@ class DocMirror:
 
     # -- row / fragment bookkeeping ----------------------------------------
 
-    def _add_row(self, slot, clock, length, origin, right_origin, is_gc, content):
+    def _add_row(self, slot, clock, length, origin, right_origin, is_gc, content,
+                 content_ref=0):
         row = len(self.row_slot)
         self.row_slot.append(slot)
         self.row_clock.append(clock)
@@ -279,8 +360,11 @@ class DocMirror:
             self.row_right_slot.append(self.slot(right_origin[0]))
             self.row_right_clock.append(right_origin[1])
         self.row_is_gc.append(is_gc)
-        self.row_countable.append(bool(content is not None and content.countable))
+        # countable by wire ref: GC(0), ContentDeleted(1), ContentFormat(6)
+        # are not countable (reference Item.js info BIT2 rules)
+        self.row_countable.append(not is_gc and content_ref not in (0, 1, 6))
         self.row_content.append(content)
+        self.row_content_ref.append(content_ref)
         # fragment index insert (appends are the common case)
         fc, fr = self.frag_clock[slot], self.frag_row[slot]
         if not fc or clock > fc[-1]:
@@ -306,13 +390,20 @@ class DocMirror:
             return i
         return None
 
+    def realized_content(self, row: int):
+        """The row's content object, decoding the lazy payload on demand."""
+        content = self.row_content[row]
+        if isinstance(content, LazyContent):
+            content = content.realize()
+            self.row_content[row] = content
+        return content
+
     def _split_existing(self, slot: int, frag_idx: int, at_clock: int, plan: StepPlan):
         """Split an integrated row so a fragment starts at ``at_clock``;
         record the link-surgery instruction for the device."""
         row = self.frag_row[slot][frag_idx]
         offset = at_clock - self.row_clock[row]
-        content = self.row_content[row]
-        right_content = content.splice(offset)
+        right_content = self.realized_content(row).splice(offset)
         new_row = self._add_row(
             slot,
             at_clock,
@@ -321,6 +412,7 @@ class DocMirror:
             self._right_origin_of(row),
             False,
             right_content,
+            self.row_content_ref[row],
         )
         self.row_len[row] = offset
         plan.splits.append((row, new_row))
@@ -344,8 +436,8 @@ class DocMirror:
             raise UnsupportedUpdate("nested parent / map entry")
         if ref.parent_name is not None and ref.parent_name != self.root_name:
             raise UnsupportedUpdate(f"root type {ref.parent_name!r}")
-        if isinstance(ref.content, (ContentType, ContentDoc)):
-            raise UnsupportedUpdate(type(ref.content).__name__)
+        if ref.content_ref in (7, 9):  # ContentType / ContentDoc
+            raise UnsupportedUpdate(f"content ref {ref.content_ref}")
 
     # -- the flush pipeline -------------------------------------------------
 
@@ -514,10 +606,11 @@ class DocMirror:
                 self._add_row(slot, ref.clock, ref.length, None, None, True, None)
                 continue
             row = self._add_row(
-                slot, ref.clock, ref.length, ref.origin, ref.right_origin, False, ref.content
+                slot, ref.clock, ref.length, ref.origin, ref.right_origin, False,
+                ref.content, ref.content_ref,
             )
             plan.sched.append((row, left_row, right_row))
-            if isinstance(ref.content, ContentDeleted):
+            if ref.content_ref == 1:  # ContentDeleted
                 applicable.append((ref.client, ref.clock, ref.length))
 
         # -- resolve delete ranges to row ids ------------------------------

@@ -39,7 +39,7 @@ def visible_text(mirror, rows, deleted) -> str:
     for r, d in zip(rows, deleted):
         if d or not mirror.row_countable[r]:
             continue
-        content = mirror.row_content[r]
+        content = mirror.realized_content(r)
         s = getattr(content, "str", None)
         if s is not None:
             out.append(s)
