@@ -1,0 +1,117 @@
+"""TpuProvider: CPU clients syncing against the batched device backend with
+randomized delivery — the provider-boundary fuzz of SURVEY.md §4.2-4.3."""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.provider import TpuProvider
+
+
+def client_edit(gen, doc):
+    t = doc.get_text("text")
+    ln = len(t.to_string())
+    if gen.random() < 0.7 or ln == 0:
+        t.insert(gen.randint(0, ln), gen.choice(["x", "yy", "zzz", "🙂", "word "]))
+    else:
+        pos = gen.randrange(ln)
+        t.delete(pos, min(gen.randint(1, 3), ln - pos))
+
+
+class TestProvider:
+    def test_single_room_two_clients(self):
+        prov = TpuProvider(4)
+        a = Y.Doc(gc=False)
+        a.client_id = 1
+        b = Y.Doc(gc=False)
+        b.client_id = 2
+        a.get_text("text").insert(0, "from-a ")
+        b.get_text("text").insert(0, "from-b ")
+        prov.receive_update("room", Y.encode_state_as_update(a))
+        prov.receive_update("room", Y.encode_state_as_update(b))
+        # handshake: each client syncs down the provider's merged state
+        for d in (a, b):
+            reply = prov.handle_sync_message("room", _step1(d))
+            _apply_step2(d, reply)
+        assert a.get_text("text").to_string() == b.get_text("text").to_string()
+        assert prov.text("room") == a.get_text("text").to_string()
+
+    def test_many_rooms_batched(self):
+        n = 8
+        prov = TpuProvider(n)
+        docs = []
+        for i in range(n):
+            d = Y.Doc(gc=False)
+            d.client_id = 100 + i
+            d.get_text("text").insert(0, f"room-{i} content")
+            docs.append(d)
+            prov.receive_update(f"room{i}", Y.encode_state_as_update(d))
+        prov.flush()
+        for i, d in enumerate(docs):
+            assert prov.text(f"room{i}") == d.get_text("text").to_string()
+
+    def test_unsupported_room_falls_back(self):
+        prov = TpuProvider(2)
+        d = Y.Doc(gc=False)
+        d.client_id = 5
+        d.get_map("meta").set("k", 1)
+        d.get_text("text").insert(0, "t")
+        prov.receive_update("mixed", Y.encode_state_as_update(d))
+        prov.flush()
+        assert prov.n_fallback_docs == 1
+        assert prov.text("mixed") == "t"
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_random_delivery(self, seed):
+        gen = random.Random(seed)
+        n_clients = 3
+        prov = TpuProvider(2)
+        docs = [Y.Doc(gc=False) for _ in range(n_clients)]
+        queues = [[] for _ in range(n_clients)]  # provider -> nothing; client updates
+        for i, d in enumerate(docs):
+            d.client_id = 10 + i
+            d.on("update", lambda u, o, dd, i=i: queues[i].append(u))
+        for _ in range(60):
+            i = gen.randrange(n_clients)
+            client_edit(gen, docs[i])
+            if gen.random() < 0.4:
+                # deliver a random prefix of a random client's updates
+                src = gen.randrange(n_clients)
+                if queues[src]:
+                    k = gen.randint(1, len(queues[src]))
+                    picks = gen.sample(queues[src], k)  # random order + subset
+                    for u in picks:
+                        prov.receive_update("room", u)
+            if gen.random() < 0.3:
+                prov.flush()
+        # final: everything reaches the provider, clients sync down
+        for q in queues:
+            for u in q:
+                prov.receive_update("room", u)
+        prov.flush()
+        for d in docs:
+            reply = prov.handle_sync_message("room", _step1(d))
+            _apply_step2(d, reply)
+            # push anything the provider missed (none expected) then compare
+        texts = {d.get_text("text").to_string() for d in docs}
+        assert len(texts) == 1
+        assert prov.text("room") in texts
+        assert not prov.engine.has_pending(prov.doc_id("room"))
+
+
+def _step1(doc):
+    from yjs_tpu.lib0.encoding import Encoder
+    from yjs_tpu.sync import protocol
+
+    enc = Encoder()
+    protocol.write_sync_step1(enc, doc)
+    return enc.to_bytes()
+
+
+def _apply_step2(doc, reply):
+    from yjs_tpu.lib0.decoding import Decoder
+    from yjs_tpu.lib0.encoding import Encoder
+    from yjs_tpu.sync import protocol
+
+    protocol.read_sync_message(Decoder(reply), Encoder(), doc)

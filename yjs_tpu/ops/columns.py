@@ -264,12 +264,87 @@ class StepPlan:
 
     n_rows: int  # total rows in the mirror after this step
     # splits of already-integrated rows: (orig_row, new_row), ordered so that
-    # multiple cuts of one original run appear right-to-left
+    # multiple cuts of one original row appear right-to-left
     splits: list[tuple[int, int]] = field(default_factory=list)
     # integration schedule: (row, left_row, right_row) in causal order
     sched: list[tuple[int, int, int]] = field(default_factory=list)
     # rows to mark deleted after integration
     delete_rows: list[int] = field(default_factory=list)
+    # 5-field bulk schedule (row, left, right, check, succ) with dependency
+    # levels (1-based): see assign_levels
+    sched5: list[tuple[int, int, int, int, int]] = field(default_factory=list)
+    levels: list[int] = field(default_factory=list)
+    n_levels: int = 0
+
+    # sentinel values in sched5
+    NO_LEFT_WRITE = -3  # chain member: placed by its predecessor's succ
+    GATHER_SUCC = -2  # succ: gather the old successor of `check` instead
+
+    def assign_levels(self, client_of_row) -> None:
+        """Rewrite the causal schedule into the level-parallel bulk form.
+
+        Items sharing a splice gap (same resolved left & right) necessarily
+        share (origin, rightOrigin) — post-split, a left row determines the
+        origin id and vice versa — so YATA orders them by ascending client
+        (reference Item.js case 1, :447-455).  The host pre-links each such
+        group into a chain spliced in ONE bulk write; remaining items get
+        one entry each.  Levels then only encode true causal depth: an
+        entry's level exceeds the level of the rows its gap depends on, and
+        no two entries in a level share a write target.
+
+        Each sched5 entry is (row, left, right, check, succ):
+        - fast iff rl[check] == right (check==NULL: head test st==right)
+        - splice: rl[left] = row (left>=0), st = row (left==NULL),
+          rl[row] = succ, where succ==GATHER_SUCC means the gathered old
+          successor of `check`
+        - on fast-check failure the item integrates sequentially with
+          (row, check, right) — the original YATA inputs.
+        """
+        groups: dict[tuple[int, int], list[int]] = {}
+        order: list[tuple[int, int]] = []
+        for i, (row, left, right) in enumerate(self.sched):
+            key = (left, right)
+            g = groups.get(key)
+            if g is None:
+                groups[key] = [i]
+                order.append(key)
+            else:
+                g.append(i)
+
+        self.sched5 = []
+        self.levels = []
+        lev_of_row: dict[int, int] = {}
+        used: set[tuple[int, int]] = set()
+        n_levels = 0
+        for key in order:
+            left, right = key
+            idxs = groups[key]
+            members = [self.sched[i][0] for i in idxs]
+            if len(members) > 1:
+                members.sort(key=client_of_row)
+            base = 1 + max(lev_of_row.get(left, 0), lev_of_row.get(right, 0))
+            gap = left if left != NULL else -2
+            lev = base
+            while (lev, gap) in used:
+                lev += 1
+            used.add((lev, gap))
+            for j, row in enumerate(members):
+                entry_left = left if j == 0 else self.NO_LEFT_WRITE
+                succ = members[j + 1] if j + 1 < len(members) else self.GATHER_SUCC
+                self.sched5.append((row, entry_left, right, left, succ))
+                self.levels.append(lev)
+                lev_of_row[row] = lev
+            n_levels = max(n_levels, lev)
+        self.n_levels = n_levels
+
+    def packed_levels(self) -> list[list[tuple[int, int, int, int, int]]]:
+        """The 5-field schedule grouped level-major ([L, W, 5] device pack)."""
+        out: list[list[tuple[int, int, int, int, int]]] = [
+            [] for _ in range(self.n_levels)
+        ]
+        for entry, lev in zip(self.sched5, self.levels):
+            out[lev - 1].append(entry)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -365,6 +440,10 @@ class DocMirror:
         self.row_countable.append(not is_gc and content_ref not in (0, 1, 6))
         self.row_content.append(content)
         self.row_content_ref.append(content_ref)
+        if is_gc:
+            # GC structs are always deleted: they belong in the derived
+            # DeleteSet (reference DeleteSet.js createDeleteSetFromStructStore)
+            self._note_deleted(slot, clock, length)
         # fragment index insert (appends are the common case)
         fc, fr = self.frag_clock[slot], self.frag_row[slot]
         if not fc or clock > fc[-1]:
@@ -631,6 +710,7 @@ class DocMirror:
             self._note_deleted(slot, clock, ln)
 
         plan.n_rows = self.n_rows
+        plan.assign_levels()
         return plan
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
@@ -643,6 +723,105 @@ class DocMirror:
         return {
             self.client_of_slot[s]: st for s, st in enumerate(self.state) if st > 0
         }
+
+    def encode_state_vector(self) -> bytes:
+        from ..coding import DSEncoderV1
+        from ..updates import write_state_vector
+
+        encoder = DSEncoderV1()
+        write_state_vector(encoder, self.state_vector())
+        return encoder.to_bytes()
+
+    def delete_set(self):
+        """The doc's derived DeleteSet (reference
+        createDeleteSetFromStructStore, DeleteSet.js:185-210)."""
+        from ..core import DeleteItem, DeleteSet, sort_and_merge_delete_set
+
+        ds = DeleteSet()
+        for slot, ranges in self.ds.items():
+            ds.clients[self.client_of_slot[slot]] = [
+                DeleteItem(clock, ln) for clock, ln in ranges
+            ]
+        sort_and_merge_delete_set(ds)
+        return ds
+
+    def encode_state_as_update(self, target_sv: dict[int, int] | None = None,
+                               v2: bool = False) -> bytes:
+        """Wire-encode this doc's missing state directly from the columns —
+        the columnar writeStateAsUpdate (reference encoding.js:490-493,
+        writeClientsStructs :94-116, Item.write Item.js:625-658).
+
+        Emitted runs follow the mirror's fragmentation (never re-merged);
+        the update is byte-valid and state-equivalent, like any Yjs update.
+        """
+        from ..coding import UpdateEncoderV1, UpdateEncoderV2
+        from ..core import write_delete_set
+        from ..lib0 import encoding as lib0enc
+
+        target_sv = target_sv or {}
+        encoder = UpdateEncoderV2() if v2 else UpdateEncoderV1()
+        # clients with news, descending id ("heavily improves the conflict
+        # algorithm", reference encoding.js:112)
+        todo = []
+        for slot, st in enumerate(self.state):
+            client = self.client_of_slot[slot]
+            clock = target_sv.get(client, 0)
+            if st > clock:
+                todo.append((client, slot, clock))
+        todo.sort(reverse=True)
+        lib0enc.write_var_uint(encoder.rest_encoder, len(todo))
+        for client, slot, clock in todo:
+            fc, fr = self.frag_clock[slot], self.frag_row[slot]
+            i = bisect.bisect_right(fc, clock) - 1
+            if i < 0:
+                i = 0
+            lib0enc.write_var_uint(encoder.rest_encoder, len(fc) - i)
+            encoder.write_client(client)
+            lib0enc.write_var_uint(encoder.rest_encoder, clock)
+            first = True
+            for j in range(i, len(fc)):
+                row = fr[j]
+                offset = clock - self.row_clock[row] if first else 0
+                first = False
+                self._write_row(encoder, row, max(0, offset))
+        write_delete_set(encoder, self.delete_set())
+        return encoder.to_bytes()
+
+    def _write_row(self, encoder, row: int, offset: int) -> None:
+        """Wire-encode one row (reference Item.js:625-658 / GC.js:45-48)."""
+        from ..ids import create_id
+
+        if self.row_is_gc[row]:
+            encoder.write_info(0)
+            encoder.write_len(self.row_len[row] - offset)
+            return
+        oslot = self.row_origin_slot[row]
+        rslot = self.row_right_slot[row]
+        if offset > 0:
+            origin = create_id(
+                self.client_of_slot[self.row_slot[row]],
+                self.row_clock[row] + offset - 1,
+            )
+        elif oslot != NULL:
+            origin = create_id(self.client_of_slot[oslot], self.row_origin_clock[row])
+        else:
+            origin = None
+        right = (
+            create_id(self.client_of_slot[rslot], self.row_right_clock[row])
+            if rslot != NULL
+            else None
+        )
+        ref = self.row_content_ref[row]
+        info = ref | (0 if origin is None else BIT8) | (0 if right is None else BIT7)
+        encoder.write_info(info)
+        if origin is not None:
+            encoder.write_left_id(origin)
+        if right is not None:
+            encoder.write_right_id(right)
+        if origin is None and right is None:
+            encoder.write_parent_info(True)  # device rows parent = root type
+            encoder.write_string(self.root_name)
+        self.realized_content(row).write(encoder, offset)
 
     def origin_rows(self) -> np.ndarray:
         """For every row, the row *containing* its origin id (NULL if no

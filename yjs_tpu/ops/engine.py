@@ -11,6 +11,8 @@ back to the CPU reference core — the Provider gating seam.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 try:  # pragma: no cover - exercised implicitly on import
@@ -88,10 +90,10 @@ class BatchEngine:
         # CPU fallback docs (Provider gating): doc idx -> Doc
         self.fallback: dict[int, Doc] = {}
         self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
-        # persistent device state
+        # persistent device state (no left-link array: order is ranked from
+        # right links with a host-known membership mask)
         self._cap = 0  # row capacity N (arrays are [B, N+1] with scratch row)
         self._right = None
-        self._left = None
         self._deleted = None
         self._start = None
 
@@ -126,18 +128,15 @@ class BatchEngine:
         old_cap = self._cap
         self._cap = cap
         new_right = np.full((b, cap + 1), NULL, np.int32)
-        new_left = np.full((b, cap + 1), NULL, np.int32)
         new_deleted = np.zeros((b, cap + 1), bool)
         if self._right is not None:
-            # old scratch column old_cap becomes a real row slot: reset it
+            # old scratch region is reset to NULL by the fresh allocation
             new_right[:, :old_cap] = np.asarray(self._right)[:, :old_cap]
-            new_left[:, :old_cap] = np.asarray(self._left)[:, :old_cap]
             new_deleted[:, :old_cap] = np.asarray(self._deleted)[:, :old_cap]
             start = np.asarray(self._start)
         else:
             start = np.full((b,), NULL, np.int32)
         self._right = jnp.asarray(new_right)
-        self._left = jnp.asarray(new_left)
         self._deleted = jnp.asarray(new_deleted)
         self._start = jnp.asarray(start)
 
@@ -154,16 +153,23 @@ class BatchEngine:
                 self._demote(i)
         if not plans:
             return
-        max_rows = max((p.n_rows for p in plans.values()), default=0)
-        self._ensure_capacity(max_rows)
-        b, cap = self.n_docs, self._cap
-
         n_splits = _bucket(max((len(p.splits) for p in plans.values()), default=0), 1)
         n_sched = _bucket(max((len(p.sched) for p in plans.values()), default=0), 1)
         n_del = _bucket(max((len(p.delete_rows) for p in plans.values()), default=0), 1)
+        packed = {i: p.packed_levels() for i, p in plans.items()}
+        n_lv = _bucket(max((len(pk) for pk in packed.values()), default=0), 1)
+        w_lv = _bucket(
+            max((len(lv) for pk in packed.values() for lv in pk), default=0), 1
+        )
+        max_rows = max((p.n_rows for p in plans.values()), default=0)
+        # reserve >= 2*w_lv spare row slots per doc: the level kernel's
+        # merged scatter uses two unique scratch lanes per schedule slot
+        self._ensure_capacity(max_rows + 2 * w_lv)
+        b, cap = self.n_docs, self._cap
 
         splits = np.full((b, n_splits, 2), NULL, np.int32)
         sched = np.full((b, n_sched, 3), NULL, np.int32)
+        lv_sched = np.full((b, n_lv, w_lv, 3), NULL, np.int32)
         dels = np.full((b, n_del), NULL, np.int32)
         statics = {
             "client_key": np.zeros((b, cap + 1), np.uint32),
@@ -184,19 +190,36 @@ class BatchEngine:
                 splits[i, : len(p.splits)] = p.splits
             if p.sched:
                 sched[i, : len(p.sched)] = p.sched
+            for lv, triples in enumerate(packed[i]):
+                if triples:
+                    lv_sched[i, lv, : len(triples)] = triples
             if p.delete_rows:
                 dels[i, : len(p.delete_rows)] = p.delete_rows
 
+        scratch_base = np.zeros((b,), np.int32)
+        for i, p in plans.items():
+            scratch_base[i] = p.n_rows
+
         statics = {k: jnp.asarray(v) for k, v in statics.items()}
-        dyn = (self._right, self._left, self._deleted, self._start)
-        args = (statics, dyn, jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels))
+        dyn = (self._right, self._deleted, self._start)
         if self._sharded_step is not None:
             # keep metrics as device scalars: converting here would block the
             # async dispatch and serialize host transcode with device compute
-            new_dyn, self._metrics_dev = self._sharded_step(*args)
+            new_dyn, self._metrics_dev = self._sharded_step(
+                statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
+                jnp.asarray(dels), jnp.asarray(scratch_base),
+            )
+        elif os.environ.get("YTPU_KERNEL") == "seq":
+            new_dyn = kernels.batch_step(
+                statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
+                jnp.asarray(dels),
+            )
         else:
-            new_dyn = kernels.batch_step(*args)
-        self._right, self._left, self._deleted, self._start = new_dyn
+            new_dyn = kernels.batch_step_levels(
+                statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
+                jnp.asarray(dels), jnp.asarray(scratch_base),
+            )
+        self._right, self._deleted, self._start = new_dyn
 
     @property
     def last_metrics(self) -> dict | None:
@@ -217,12 +240,20 @@ class BatchEngine:
 
     def _order(self, doc: int) -> tuple[np.ndarray, np.ndarray]:
         """Document-order row ids + deleted flags for one doc."""
-        if self._left is None:
+        if self._right is None:
             return np.zeros(0, np.int64), np.zeros(0, bool)
-        ranks = np.asarray(kernels.list_ranks(self._left, self._start))[doc]
+        m = self.mirrors[doc]
+        valid_host = np.zeros(self._right.shape[1], bool)
+        n = m.n_rows
+        if n:
+            valid_host[:n] = ~np.asarray(m.row_is_gc[:n], bool)
+        d = np.asarray(
+            kernels.list_ranks(self._right[doc : doc + 1], jnp.asarray(valid_host)[None])
+        )[0]
         deleted = np.asarray(self._deleted)[doc]
-        rows = np.nonzero(ranks >= 0)[0]
-        rows = rows[np.argsort(ranks[rows], kind="stable")]
+        rows = np.nonzero(d >= 0)[0]
+        # larger distance-to-tail = earlier in the document
+        rows = rows[np.argsort(-d[rows], kind="stable")]
         return rows, deleted[rows]
 
     def rows_in_order(self, doc: int) -> list[tuple[int, int, int, bool]]:
@@ -255,6 +286,31 @@ class BatchEngine:
             return fb.get_text(self.root_name).to_string()
         rows, dels = self._order(doc)
         return visible_text(self.mirrors[doc], rows, dels)
+
+    def encode_state_vector(self, doc: int) -> bytes:
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            from ..updates import encode_state_vector
+
+            return encode_state_vector(fb)
+        return self.mirrors[doc].encode_state_vector()
+
+    def encode_state_as_update(
+        self, doc: int, encoded_target_sv: bytes | None = None, v2: bool = False
+    ) -> bytes:
+        """Sync step 2 straight from the columnar mirror (no CPU Doc)."""
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            from ..updates import encode_state_as_update, encode_state_as_update_v2
+
+            f = encode_state_as_update_v2 if v2 else encode_state_as_update
+            return f(fb, encoded_target_sv)
+        target = None
+        if encoded_target_sv is not None:
+            from ..updates import decode_state_vector
+
+            target = decode_state_vector(encoded_target_sv)
+        return self.mirrors[doc].encode_state_as_update(target, v2=v2)
 
     def has_pending(self, doc: int) -> bool:
         if doc in self.fallback:

@@ -53,13 +53,15 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
 
     statics: dict of [N+1] columns (client_key u32, origin_slot/clock,
         right_slot/clock, origin_row  i32)
-    dyn: (right_link[N+1], left_link[N+1], deleted[N+1], start  — i32/bool)
+    dyn: (right_link[N+1], deleted[N+1], start — i32/bool; no left-link
+        array: the head test is start==row and document order is ranked
+        from right links alone)
     splits: [S, 2] i32 (orig_row, new_row), NULL-padded, right-to-left per
         original row
     sched: [M, 3] i32 (row, left_row, right_row), NULL-padded, causal order
     delete_rows: [D] i32, NULL-padded
     """
-    right_link, left_link, deleted, start = dyn
+    right_link, deleted, start = dyn
     n1 = right_link.shape[0]
     dummy = n1 - 1
 
@@ -73,37 +75,62 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
     # -- split pre-pass: link surgery for host-computed run splits ----------
     # (the device half of splitItem, reference src/structs/Item.js:84-120)
     def split_body(carry, instr):
-        rl, ll, dl = carry
+        rl, dl = carry
         orig, new = instr[0], instr[1]
         valid = orig >= 0
         safe_orig = jnp.where(valid, orig, dummy)
         old_right = rl[safe_orig]
         rl = _upd(rl, new, old_right, valid, dummy)
         rl = _upd(rl, orig, new, valid, dummy)
-        ll = _upd(ll, new, orig, valid, dummy)
-        ll = _upd(ll, old_right, new, valid & (old_right >= 0), dummy)
         dl = _upd(dl, new, dl[safe_orig], valid, dummy)
-        return (rl, ll, dl), None
+        return (rl, dl), None
 
-    (right_link, left_link, deleted), _ = lax.scan(
-        split_body, (right_link, left_link, deleted), splits
+    (right_link, deleted), _ = lax.scan(
+        split_body, (right_link, deleted), splits
     )
 
-    # -- integration scan ---------------------------------------------------
+    # -- integration scan: one item per sequential step ---------------------
+    integrate_item = _make_integrate_item(statics, dummy)
+
     def integ_body(carry, s):
-        rl, ll, st, visit, counter = carry
-        k, left0, right0 = s[0], s[1], s[2]
+        carry = integrate_item(carry, s[0], s[1], s[2])
+        return carry, None
+
+    (right_link, start), _ = lax.scan(
+        integ_body, (right_link, start), sched
+    )
+
+    deleted = _apply_deletes(deleted, delete_rows, dummy)
+    return right_link, deleted, start
+
+
+def _make_integrate_item(statics, dummy):
+    """The single-item YATA integrate (conflict scan + splice) as a carry
+    transformer — shared by the sequential path and the level path's
+    deferred (true-conflict) loop."""
+    client_key = statics["client_key"]
+    oslot = statics["origin_slot"]
+    oclock = statics["origin_clock"]
+    rslot = statics["right_slot"]
+    rclock = statics["right_clock"]
+    origin_row = statics["origin_row"]
+
+    def integrate_item(carry, k, left0, right0):
+        rl, st = carry
+        n1 = rl.shape[0]
+        # per-scan conflict sets: fresh visit marks, so no cross-scan counter
+        visit = jnp.full((n1,), -1, jnp.int32)
+        counter = jnp.int32(0)
         valid = k >= 0
         safe_k = jnp.where(valid, k, dummy)
         safe_l = jnp.where(left0 >= 0, left0, dummy)
-        safe_r = jnp.where(right0 >= 0, right0, dummy)
 
         # fast path, the negation of reference Item.js:432-434: skip the
-        # conflict scan when left is null and right is the current list head,
-        # or when left.right is still exactly right
+        # conflict scan when left is null and right is the current list head
+        # (st == right0), or when left.right is still exactly right
         skip = jnp.where(
             left0 == NULL,
-            (right0 != NULL) & (ll[safe_r] == NULL),
+            (right0 != NULL) & (st == right0),
             rl[safe_l] == right0,
         )
 
@@ -163,31 +190,142 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
         rl = _upd(rl, left, k, valid & (left != NULL), dummy)
         st = jnp.where(valid & (left == NULL), k, st)
         rl = _upd(rl, k, right2, valid, dummy)
-        ll = _upd(ll, k, left, valid, dummy)
-        ll = _upd(ll, right2, k, valid & (right2 != NULL), dummy)
-        return (rl, ll, st, visit, counter), None
+        return (rl, st)
 
-    visit0 = jnp.full((n1,), -1, jnp.int32)
-    (right_link, left_link, start, _visit, _counter), _ = lax.scan(
-        integ_body, (right_link, left_link, start, visit0, jnp.int32(0)), sched
-    )
+    return integrate_item
 
-    # -- delete marking (reference DeleteSet.js readAndApplyDeleteSet tail) -
+
+def _apply_deletes(deleted, delete_rows, dummy):
+    # (reference DeleteSet.js readAndApplyDeleteSet tail)
     valid_d = delete_rows >= 0
     deleted = deleted.at[jnp.where(valid_d, delete_rows, dummy)].set(
         jnp.where(valid_d, True, deleted[dummy])
     )
+    return deleted
 
-    return right_link, left_link, deleted, start
+
+def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
+    """Level-parallel integration for a single doc.
+
+    ``scratch_base`` is this doc's row count: rows beyond it are unused
+    padding, used as per-lane scratch so masked bulk scatters have UNIQUE
+    indices (duplicate scatter indices serialize on TPU).  The engine
+    guarantees >= W spare slots and masks phantom rows at export.
+
+    ``lv_sched`` is the schedule packed level-major, [L, W, 3] NULL-padded:
+    items in one dependency level (host-assigned, see
+    StepPlan.assign_levels) have distinct splice gaps and already-placed
+    deps, so every fast-path item in a level splices in ONE vectorized
+    pass; only true conflicts (stale pointers — concurrent edits at one
+    position) fall back to the sequential YATA scan.  Collapses the
+    per-item lax.scan of `_doc_step` (~#items steps) into ~#levels steps of
+    width ~W.
+    """
+    right_link, deleted, start = dyn
+    n1 = right_link.shape[0]
+    dummy = n1 - 1
+
+    # split pre-pass (identical to _doc_step)
+    def split_body(carry, instr):
+        rl, dl = carry
+        orig, new = instr[0], instr[1]
+        valid = orig >= 0
+        safe_orig = jnp.where(valid, orig, dummy)
+        old_right = rl[safe_orig]
+        rl = _upd(rl, new, old_right, valid, dummy)
+        rl = _upd(rl, orig, new, valid, dummy)
+        dl = _upd(dl, new, dl[safe_orig], valid, dummy)
+        return (rl, dl), None
+
+    (right_link, deleted), _ = lax.scan(
+        split_body, (right_link, deleted), splits
+    )
+
+    integrate_item = _make_integrate_item(statics, dummy)
+
+    def level_body(carry, lv):
+        rl, st = carry
+        k = lv[:, 0]
+        l0 = lv[:, 1]
+        r0 = lv[:, 2]
+        w = k.shape[0]
+        mask = k >= 0
+        safe_l = jnp.where(l0 >= 0, l0, dummy)
+
+        # vectorized fast-path check across the level (head test: st == r0)
+        rl_l = rl[safe_l]
+        fast = mask & jnp.where(
+            l0 == NULL,
+            jnp.where(r0 == NULL, st == NULL, st == r0),
+            rl_l == r0,
+        )
+
+        # bulk splice of all fast items (gaps are distinct by construction):
+        # ONE scatter for both writes (rl[l0]=k and rl[k]=right2).  masked
+        # lanes write to unique scratch slots — duplicate indices would
+        # serialize the scatter on TPU
+        lanes = scratch_base + jnp.arange(2 * w, dtype=jnp.int32)
+        right2 = jnp.where(l0 == NULL, st, rl_l)
+        cond1 = fast & (l0 != NULL)
+        idx = jnp.concatenate([
+            jnp.where(cond1, l0, lanes[:w]),
+            jnp.where(fast, k, lanes[w:]),
+        ])
+        val = jnp.concatenate([
+            jnp.where(cond1, k, NULL),
+            jnp.where(fast, right2, NULL),
+        ])
+        rl = rl.at[idx].set(val, unique_indices=True)
+        head_k = jnp.max(jnp.where(fast & (l0 == NULL), k, NULL))
+        st = jnp.where(head_k >= 0, head_k, st)
+
+        # deferred: true conflicts run the sequential YATA scan, one by one
+        pending = mask & ~fast
+
+        def defer_cond(cs):
+            pending, _carry = cs
+            return jnp.any(pending)
+
+        def defer_body(cs):
+            pending, carry = cs
+            j = jnp.argmax(pending)
+            carry = integrate_item(carry, k[j], l0[j], r0[j])
+            return pending.at[j].set(False), carry
+
+        _, (rl, st) = lax.while_loop(
+            defer_cond, defer_body, (pending, (rl, st))
+        )
+        return (rl, st), None
+
+    (right_link, start), _ = lax.scan(
+        level_body,
+        (right_link, start),
+        lv_sched,
+    )
+
+    deleted = _apply_deletes(deleted, delete_rows, dummy)
+    return right_link, deleted, start
 
 
 @functools.partial(jax.jit, donate_argnums=(1,))
 def batch_step(statics, dyn, splits, sched, delete_rows):
-    """vmapped integration step over the doc batch.
+    """vmapped per-item integration step over the doc batch.
 
     All arguments are dicts/tuples of arrays with a leading doc axis [B, ...].
     """
     return jax.vmap(_doc_step)(statics, dyn, splits, sched, delete_rows)
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
+    """vmapped level-parallel integration step (the default engine path).
+
+    lv_sched: [B, L, W, 3] level-major schedule, NULL-padded.
+    scratch_base: [B] i32 per-doc row count (see _doc_step_levels).
+    """
+    return jax.vmap(_doc_step_levels)(
+        statics, dyn, splits, lv_sched, delete_rows, scratch_base
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -195,26 +333,26 @@ def batch_step(statics, dyn, splits, sched, delete_rows):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def list_ranks(left_link, start):
-    """List ranking by pointer doubling: rank[i] = #predecessors of row i in
-    its doc's linked list; invalid rows get rank -1.
+def list_ranks(right_link, valid):
+    """Document order from right links by pointer doubling: d[i] = distance
+    to the list tail; sorting valid rows by descending d gives the order.
 
-    left_link: [B, N+1] i32, start: [B] i32.  log2(N) rounds of gathers —
-    the parallel-prefix replacement for walking `right` pointers.
+    right_link: [B, N+1] i32; valid: [B, N+1] bool host-known membership
+    (non-GC mirrored rows; scratch cells excluded).  Returns d with -1 on
+    invalid rows.
     """
-    b, n1 = left_link.shape
-    idx = jnp.arange(n1, dtype=jnp.int32)[None, :]
-    in_list = (left_link != NULL) | (idx == start[:, None])
-    in_list = in_list & (idx != n1 - 1)  # scratch row is never real
-    d = jnp.where(left_link != NULL, 1, 0).astype(jnp.int32)
-    p = jnp.where(in_list, left_link, NULL)
+    b, n1 = right_link.shape
+    d = jnp.where(right_link != NULL, 1, 0).astype(jnp.int32)
+    p = right_link
     n_rounds = max(1, math.ceil(math.log2(max(2, n1))))
     for _ in range(n_rounds):
         safe_p = jnp.where(p != NULL, p, 0)
         d = d + jnp.where(p != NULL, jnp.take_along_axis(d, safe_p, axis=1), 0)
         p = jnp.where(p != NULL, jnp.take_along_axis(p, safe_p, axis=1), NULL)
-    return jnp.where(in_list, d, NULL)
+    return jnp.where(valid, d, NULL)
+
+
+list_ranks = jax.jit(list_ranks)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

@@ -64,15 +64,17 @@ def doc_mesh(
 def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
     """The engine step sharded over the doc axis.
 
-    Returns a jitted fn with the same signature as
-    :func:`yjs_tpu.ops.kernels.batch_step` plus a replicated metrics dict
-    (psum over ICI) so every host sees global progress counters.
+    Returns a jitted fn with the signature of
+    :func:`yjs_tpu.ops.kernels.batch_step_levels` plus a replicated metrics
+    dict (psum over ICI) so every host sees global progress counters.
     """
     spec = P(axis)
 
-    def local_step(statics, dyn, splits, sched, delete_rows):
-        out = jax.vmap(kernels._doc_step)(statics, dyn, splits, sched, delete_rows)
-        integrated = jnp.sum(sched[..., 0] >= 0)
+    def local_step(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
+        out = jax.vmap(kernels._doc_step_levels)(
+            statics, dyn, splits, lv_sched, delete_rows, scratch_base
+        )
+        integrated = jnp.sum(lv_sched[..., 0] >= 0)
         deleted = jnp.sum(delete_rows >= 0)
         metrics = {
             "integrated": lax.psum(integrated, axis),
@@ -83,8 +85,8 @@ def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
     sharded = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec, spec),
-        out_specs=((spec, spec, spec, spec), P()),
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=((spec, spec, spec), P()),
     )
     # donate the persistent dyn buffers like kernels.batch_step does
     return jax.jit(sharded, donate_argnums=(1,))

@@ -1,0 +1,106 @@
+"""The y-tpu Provider: the gating boundary of BASELINE.json's north star.
+
+A Provider owns a fleet of documents (think: a collaboration server holding
+thousands of rooms).  Pending binary updates are marshalled per doc and
+integrated in one batched device step at ``flush()``; docs whose traffic
+falls outside the device path's scope are transparently served by the CPU
+reference core (the same wire bytes, the same sync contract — reference
+README.md:101-137 describes the provider seam this implements).
+
+Speaks the y-protocols sync framing via :mod:`yjs_tpu.sync.protocol`:
+step 1 (state vector) / step 2 (diff update) / incremental updates.
+"""
+
+from __future__ import annotations
+
+from .lib0.decoding import Decoder
+from .lib0.encoding import Encoder
+from .lib0 import decoding, encoding
+from .ops.engine import BatchEngine
+from .sync import protocol
+
+
+class TpuProvider:
+    """Batched multi-doc provider backed by :class:`BatchEngine`."""
+
+    def __init__(self, n_docs: int, root_name: str = "text", mesh=None):
+        self.engine = BatchEngine(n_docs, root_name=root_name, mesh=mesh)
+        self._guids: dict[str, int] = {}
+        self._next = 0
+        self._dirty = False
+
+    # -- doc management -----------------------------------------------------
+
+    def doc_id(self, guid: str) -> int:
+        """The engine slot for a doc guid (allocating on first use)."""
+        i = self._guids.get(guid)
+        if i is None:
+            if self._next >= self.engine.n_docs:
+                raise ValueError("provider is full")
+            i = self._next
+            self._next += 1
+            self._guids[guid] = i
+        return i
+
+    # -- update plumbing ----------------------------------------------------
+
+    def receive_update(self, guid: str, update: bytes, v2: bool = False) -> None:
+        self.engine.queue_update(self.doc_id(guid), update, v2=v2)
+        self._dirty = True
+
+    def flush(self) -> None:
+        """Run one batched device integration step over all pending docs."""
+        if self._dirty:
+            self.engine.flush()
+            self._dirty = False
+
+    # -- y-protocols sync framing ------------------------------------------
+
+    def sync_step1(self, guid: str) -> bytes:
+        """Message announcing this doc's state vector (sync step 1)."""
+        enc = Encoder()
+        encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_1)
+        encoding.write_var_uint8_array(enc, self.engine.encode_state_vector(self.doc_id(guid)))
+        return enc.to_bytes()
+
+    def handle_sync_message(self, guid: str, message: bytes) -> bytes | None:
+        """Process one sync message for a doc; returns the reply, if any.
+
+        Integrates pending traffic before answering step 1 so the emitted
+        diff reflects everything received so far.
+        """
+        dec = Decoder(message)
+        msg_type = decoding.read_var_uint(dec)
+        doc = self.doc_id(guid)
+        if msg_type == protocol.MESSAGE_YJS_SYNC_STEP_1:
+            self.flush()
+            remote_sv = decoding.read_var_uint8_array(dec)
+            enc = Encoder()
+            encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_2)
+            encoding.write_var_uint8_array(
+                enc, self.engine.encode_state_as_update(doc, remote_sv)
+            )
+            return enc.to_bytes()
+        if msg_type in (protocol.MESSAGE_YJS_SYNC_STEP_2, protocol.MESSAGE_YJS_UPDATE):
+            self.engine.queue_update(doc, decoding.read_var_uint8_array(dec))
+            self._dirty = True
+            return None
+        raise ValueError(f"unknown sync message type {msg_type}")
+
+    # -- state accessors ----------------------------------------------------
+
+    def text(self, guid: str) -> str:
+        self.flush()
+        return self.engine.text(self.doc_id(guid))
+
+    def state_vector(self, guid: str) -> dict[int, int]:
+        self.flush()
+        return self.engine.state_vector(self.doc_id(guid))
+
+    def encode_state_as_update(self, guid: str, target_sv: bytes | None = None) -> bytes:
+        self.flush()
+        return self.engine.encode_state_as_update(self.doc_id(guid), target_sv)
+
+    @property
+    def n_fallback_docs(self) -> int:
+        return len(self.engine.fallback)
