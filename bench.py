@@ -108,7 +108,8 @@ def main():
     transcode_time = time.perf_counter() - t0
     n = mirror.n_rows
     # the level kernel scatters masked lanes into >= 2W spare slots past n
-    w_pad = max((len(lv) for lv in plan.packed_levels()), default=1)
+    packed = plan.packed_levels()
+    w_pad = max((len(lv) for lv in packed), default=1)
     cap = max(64, n + 2 * w_pad)
     cols = mirror.static_columns()
 
@@ -126,17 +127,15 @@ def main():
         "origin_row": pad_col("origin_row", NULL, np.int32),
     }
     sched = np.full((n_docs, 1, 3), NULL, np.int32)
-    lv_sched = np.full((n_docs, 1, 1, 3), NULL, np.int32)
+    lv_sched = np.full((n_docs, 1, 1, 5), NULL, np.int32)
     if plan.sched:
         sched = np.broadcast_to(
             np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 3)
         )
-        packed = plan.packed_levels()
-        w = max(len(lv) for lv in packed)
-        one = np.full((len(packed), w, 3), NULL, np.int32)
-        for lv, triples in enumerate(packed):
-            if triples:
-                one[lv, : len(triples)] = triples
+        one = np.full((len(packed), w_pad, 5), NULL, np.int32)
+        for lv, entries in enumerate(packed):
+            if entries:
+                one[lv, : len(entries)] = entries
         lv_sched = np.broadcast_to(one, (n_docs,) + one.shape)
     splits = np.full((n_docs, 1, 2), NULL, np.int32)
     if plan.splits:

@@ -42,6 +42,9 @@ from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
 
 NULL = -1  # null id / null row sentinel in every int column
+# sched5 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
+NO_LEFT_WRITE = -3  # chain member: placed by its predecessor's succ write
+GATHER_SUCC = -2  # succ: the old successor of `check` (== right when fast)
 
 
 # ---------------------------------------------------------------------------
@@ -276,9 +279,9 @@ class StepPlan:
     levels: list[int] = field(default_factory=list)
     n_levels: int = 0
 
-    # sentinel values in sched5
-    NO_LEFT_WRITE = -3  # chain member: placed by its predecessor's succ
-    GATHER_SUCC = -2  # succ: gather the old successor of `check` instead
+    # sentinel values in sched5 (module-level aliases for kernel import)
+    NO_LEFT_WRITE = NO_LEFT_WRITE
+    GATHER_SUCC = GATHER_SUCC
 
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
@@ -710,7 +713,7 @@ class DocMirror:
             self._note_deleted(slot, clock, ln)
 
         plan.n_rows = self.n_rows
-        plan.assign_levels()
+        plan.assign_levels(lambda r: self.client_of_slot[self.row_slot[r]])
         return plan
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:

@@ -169,7 +169,7 @@ class BatchEngine:
 
         splits = np.full((b, n_splits, 2), NULL, np.int32)
         sched = np.full((b, n_sched, 3), NULL, np.int32)
-        lv_sched = np.full((b, n_lv, w_lv, 3), NULL, np.int32)
+        lv_sched = np.full((b, n_lv, w_lv, 5), NULL, np.int32)
         dels = np.full((b, n_del), NULL, np.int32)
         statics = {
             "client_key": np.zeros((b, cap + 1), np.uint32),
@@ -190,9 +190,9 @@ class BatchEngine:
                 splits[i, : len(p.splits)] = p.splits
             if p.sched:
                 sched[i, : len(p.sched)] = p.sched
-            for lv, triples in enumerate(packed[i]):
-                if triples:
-                    lv_sched[i, lv, : len(triples)] = triples
+            for lv, entries in enumerate(packed[i]):
+                if entries:
+                    lv_sched[i, lv, : len(entries)] = entries
             if p.delete_rows:
                 dels[i, : len(p.delete_rows)] = p.delete_rows
 

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .columns import GATHER_SUCC
+
 NULL = -1
 
 
@@ -212,14 +214,16 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     indices (duplicate scatter indices serialize on TPU).  The engine
     guarantees >= W spare slots and masks phantom rows at export.
 
-    ``lv_sched`` is the schedule packed level-major, [L, W, 3] NULL-padded:
-    items in one dependency level (host-assigned, see
-    StepPlan.assign_levels) have distinct splice gaps and already-placed
-    deps, so every fast-path item in a level splices in ONE vectorized
-    pass; only true conflicts (stale pointers — concurrent edits at one
-    position) fall back to the sequential YATA scan.  Collapses the
-    per-item lax.scan of `_doc_step` (~#items steps) into ~#levels steps of
-    width ~W.
+    ``lv_sched`` is the 5-field schedule packed level-major, [L, W, 5]
+    NULL-padded rows of (row, left, right, check, succ); items in one
+    dependency level (host-assigned, see StepPlan.assign_levels) have
+    distinct splice gaps and already-placed deps, so every fast-path item
+    in a level splices in ONE vectorized pass; items sharing a gap are
+    pre-chained by the host (ascending client = YATA case-1 order,
+    reference Item.js:447-455) via the ``succ`` field, and only true
+    conflicts (stale pointers — concurrent edits at one position) fall
+    back to the sequential YATA scan.  Collapses the per-item lax.scan of
+    `_doc_step` (~#items steps) into ~#levels steps of width ~W.
     """
     right_link, deleted, start = dyn
     n1 = right_link.shape[0]
@@ -246,40 +250,45 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     def level_body(carry, lv):
         rl, st = carry
         k = lv[:, 0]
-        l0 = lv[:, 1]
+        l0 = lv[:, 1]  # left write target; NULL = head, NO_LEFT_WRITE = chained
         r0 = lv[:, 2]
+        chk = lv[:, 3]  # shared gap left (NULL = head gap)
+        succ = lv[:, 4]  # next chain member, or GATHER_SUCC = old gap successor
         w = k.shape[0]
         mask = k >= 0
-        safe_l = jnp.where(l0 >= 0, l0, dummy)
+        safe_chk = jnp.where(chk >= 0, chk, dummy)
 
-        # vectorized fast-path check across the level (head test: st == r0)
-        rl_l = rl[safe_l]
-        fast = mask & jnp.where(
-            l0 == NULL,
-            jnp.where(r0 == NULL, st == NULL, st == r0),
-            rl_l == r0,
-        )
+        # vectorized fast-path check across the level: the splice gap is
+        # intact iff the gap-left's successor is still exactly `right`
+        # (head gap: st == r0 — covers the empty-list r0==NULL case too).
+        # All members of one chain share (chk, r0), so a chain is fast or
+        # deferred as a whole.
+        fast = mask & jnp.where(chk == NULL, st == r0, rl[safe_chk] == r0)
 
         # bulk splice of all fast items (gaps are distinct by construction):
-        # ONE scatter for both writes (rl[l0]=k and rl[k]=right2).  masked
-        # lanes write to unique scratch slots — duplicate indices would
-        # serialize the scatter on TPU
+        # ONE scatter for both writes (rl[l0]=k for chain heads and
+        # rl[k]=succ for every member; GATHER_SUCC resolves to r0 because
+        # fast means rl[chk]==r0).  masked lanes write to unique scratch
+        # slots — duplicate indices would serialize the scatter on TPU
         lanes = scratch_base + jnp.arange(2 * w, dtype=jnp.int32)
-        right2 = jnp.where(l0 == NULL, st, rl_l)
-        cond1 = fast & (l0 != NULL)
+        succ_v = jnp.where(succ == GATHER_SUCC, r0, succ)
+        cond1 = fast & (l0 >= 0)
         idx = jnp.concatenate([
             jnp.where(cond1, l0, lanes[:w]),
             jnp.where(fast, k, lanes[w:]),
         ])
         val = jnp.concatenate([
             jnp.where(cond1, k, NULL),
-            jnp.where(fast, right2, NULL),
+            jnp.where(fast, succ_v, NULL),
         ])
         rl = rl.at[idx].set(val, unique_indices=True)
         head_k = jnp.max(jnp.where(fast & (l0 == NULL), k, NULL))
         st = jnp.where(head_k >= 0, head_k, st)
 
-        # deferred: true conflicts run the sequential YATA scan, one by one
+        # deferred: true conflicts run the sequential YATA scan one by one
+        # with the original YATA inputs (row, gap-left, right); chain
+        # members are processed in ascending-client order (their index
+        # order), which the conflict scan keeps correct
         pending = mask & ~fast
 
         def defer_cond(cs):
@@ -289,7 +298,7 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
         def defer_body(cs):
             pending, carry = cs
             j = jnp.argmax(pending)
-            carry = integrate_item(carry, k[j], l0[j], r0[j])
+            carry = integrate_item(carry, k[j], chk[j], r0[j])
             return pending.at[j].set(False), carry
 
         _, (rl, st) = lax.while_loop(
@@ -320,7 +329,7 @@ def batch_step(statics, dyn, splits, sched, delete_rows):
 def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     """vmapped level-parallel integration step (the default engine path).
 
-    lv_sched: [B, L, W, 3] level-major schedule, NULL-padded.
+    lv_sched: [B, L, W, 5] level-major sched5 schedule, NULL-padded.
     scratch_base: [B] i32 per-doc row count (see _doc_step_levels).
     """
     return jax.vmap(_doc_step_levels)(
