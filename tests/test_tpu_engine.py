@@ -246,3 +246,30 @@ class TestFallback:
         eng.flush()
         assert 0 in eng.fallback
         assert eng.text(0) == "hi"
+
+
+class TestUpdateLogCompaction:
+    def test_log_bounded_and_demotion_replays_snapshot(self):
+        """After >64 pending-free flushes the demotion-replay log collapses
+        to one columnar export; a later demotion must still rebuild the full
+        doc from it (engine._update_log compaction)."""
+        doc = make_doc(31)
+        t = doc.get_text("text")
+        eng = BatchEngine(1)
+        sv = None
+        for step in range(70):
+            t.insert(len(t.to_string()), f"w{step} ")
+            u = Y.encode_state_as_update(doc, sv)
+            sv = Y.encode_state_vector(doc)
+            eng.queue_update(0, u)
+            eng.flush()
+        # compacted at the 65th flush to [snapshot], then the tail appended
+        assert len(eng._update_log[0]) <= 6
+        assert_engine_matches(eng, doc)
+        # demotion after compaction replays the snapshot + tail correctly
+        doc.get_map("m").set("k", 1)  # unsupported -> demote
+        t.insert(0, "head ")
+        eng.queue_update(0, Y.encode_state_as_update(doc, sv))
+        eng.flush()
+        assert 0 in eng.fallback
+        assert eng.text(0) == t.to_string()

@@ -133,10 +133,13 @@ def decode_update_refs(update: bytes, v2: bool):
     the native columnar scanner when available (payloads stay lazy).
     """
     if not v2:
+        from ..native import NativeDecodeError
+
         try:
             return _decode_update_refs_native(update)
-        except Exception:
-            pass  # fall back to the pure-Python decoder
+        except NativeDecodeError:
+            pass  # no toolchain / malformed input: pure-Python decoder
+            # decides whether the bytes are really malformed
     decoder = Decoder(update)
     yd = UpdateDecoderV2(decoder) if v2 else UpdateDecoderV1(decoder)
     refs: dict[int, list[ItemRef]] = {}
@@ -279,10 +282,6 @@ class StepPlan:
     levels: list[int] = field(default_factory=list)
     n_levels: int = 0
 
-    # sentinel values in sched5 (module-level aliases for kernel import)
-    NO_LEFT_WRITE = NO_LEFT_WRITE
-    GATHER_SUCC = GATHER_SUCC
-
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
 
@@ -332,8 +331,8 @@ class StepPlan:
                 lev += 1
             used.add((lev, gap))
             for j, row in enumerate(members):
-                entry_left = left if j == 0 else self.NO_LEFT_WRITE
-                succ = members[j + 1] if j + 1 < len(members) else self.GATHER_SUCC
+                entry_left = left if j == 0 else NO_LEFT_WRITE
+                succ = members[j + 1] if j + 1 < len(members) else GATHER_SUCC
                 self.sched5.append((row, entry_left, right, left, succ))
                 self.levels.append(lev)
                 lev_of_row[row] = lev

@@ -221,6 +221,15 @@ class BatchEngine:
             )
         self._right, self._deleted, self._start = new_dyn
 
+        # compact long demotion-replay logs: once a doc's integrated state is
+        # pending-free, its own columnar export supersedes the raw update
+        # prefix.  After the dispatch so the O(doc) host encode overlaps
+        # device execution; amortized by the length threshold
+        for i in plans:
+            m = self.mirrors[i]
+            if len(self._update_log[i]) > 64 and not m.has_pending():
+                self._update_log[i] = [(m.encode_state_as_update(), False)]
+
     @property
     def last_metrics(self) -> dict | None:
         """Global psum'd counters from the last sharded flush (syncs)."""
