@@ -126,13 +126,13 @@ def main():
         "right_clock": pad_col("right_clock", 0, np.int32),
         "origin_row": pad_col("origin_row", NULL, np.int32),
     }
-    sched = np.full((n_docs, 1, 3), NULL, np.int32)
-    lv_sched = np.full((n_docs, 1, 1, 5), NULL, np.int32)
+    sched = np.full((n_docs, 1, 4), NULL, np.int32)
+    lv_sched = np.full((n_docs, 1, 1, 6), NULL, np.int32)
     if plan.sched:
         sched = np.broadcast_to(
-            np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 3)
+            np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 4)
         )
-        one = np.full((len(packed), w_pad, 5), NULL, np.int32)
+        one = np.full((len(packed), w_pad, 6), NULL, np.int32)
         for lv, entries in enumerate(packed):
             if entries:
                 one[lv, : len(entries)] = entries
@@ -148,11 +148,13 @@ def main():
             np.asarray(plan.delete_rows, np.int32), (n_docs, len(plan.delete_rows))
         )
 
+    seg_cap = max(8, mirror.n_segs)
+
     def fresh_dyn():
         return (
             jnp.full((n_docs, cap + 1), NULL, jnp.int32),
             jnp.zeros((n_docs, cap + 1), bool),
-            jnp.full((n_docs,), NULL, jnp.int32),
+            jnp.full((n_docs, seg_cap + 1), NULL, jnp.int32),
         )
 
     statics_d = {k: jnp.asarray(v) for k, v in statics.items()}
@@ -186,8 +188,9 @@ def main():
     from yjs_tpu.ops.engine import visible_text
 
     right, deleted, start = out
+    text_seg = mirror.segments[("text", None)]
     valid = np.zeros(cap + 1, bool)
-    valid[:n] = ~np.asarray(mirror.row_is_gc, bool)
+    valid[:n] = np.asarray(mirror.row_seg, np.int32) == text_seg
     d = np.asarray(kernels.list_ranks(right[:1], jnp.asarray(valid)[None]))[0]
     dels_out = np.asarray(deleted[0])
     rows = np.nonzero(d >= 0)[0]

@@ -55,12 +55,32 @@ class TestProvider:
         prov = TpuProvider(2)
         d = Y.Doc(gc=False)
         d.client_id = 5
-        d.get_map("meta").set("k", 1)
+        d.get_map("meta").set("nested", Y.YMap())  # ContentType -> CPU path
         d.get_text("text").insert(0, "t")
         prov.receive_update("mixed", Y.encode_state_as_update(d))
         prov.flush()
         assert prov.n_fallback_docs == 1
         assert prov.text("mixed") == "t"
+
+    def test_map_room_served_on_device(self):
+        prov = TpuProvider(2)
+        a = Y.Doc(gc=False)
+        a.client_id = 5
+        b = Y.Doc(gc=False)
+        b.client_id = 6
+        a.get_map("meta").set("k", 1)
+        a.get_text("text").insert(0, "t")
+        b.get_map("meta").set("k", 2)  # concurrent LWW conflict
+        prov.receive_update("room", Y.encode_state_as_update(a))
+        prov.receive_update("room", Y.encode_state_as_update(b))
+        prov.flush()
+        assert prov.n_fallback_docs == 0
+        # both clients sync down; all three agree on the LWW winner
+        for d in (a, b):
+            _apply_step2(d, prov.handle_sync_message("room", _step1(d)))
+        assert a.get_map("meta").to_json() == b.get_map("meta").to_json()
+        assert prov.engine.map_json(prov.doc_id("room"), "meta") == \
+            a.get_map("meta").to_json()
 
     @pytest.mark.parametrize("seed", range(4))
     def test_fuzz_random_delivery(self, seed):

@@ -27,9 +27,9 @@ def cpu_rows_in_order(doc: Y.Doc, name: str = "text"):
     return out
 
 
-def engine_rows_unit(eng: BatchEngine, i: int):
+def engine_rows_unit(eng: BatchEngine, i: int, name: str = "text"):
     out = []
-    for client, clock, length, deleted in eng.rows_in_order(i):
+    for client, clock, length, deleted in eng.rows_in_order(i, name):
         for off in range(length):
             out.append((client, clock + off, deleted))
     return out
@@ -42,11 +42,11 @@ def make_doc(client_id: int) -> Y.Doc:
 
 
 def assert_engine_matches(eng, doc: Y.Doc, idx=0, name="text"):
-    assert eng.text(idx) == doc.get_text(name).to_string()
+    assert eng.text(idx, name) == doc.get_text(name).to_string()
     assert eng.state_vector(idx) == {
         c: v for c, v in Y.get_state_vector(doc.store).items() if v > 0
     }
-    assert engine_rows_unit(eng, idx) == cpu_rows_in_order(doc, name)
+    assert engine_rows_unit(eng, idx, name) == cpu_rows_in_order(doc, name)
 
 
 def replay_into_engine(updates, n_docs=1, v2=False):
@@ -237,9 +237,23 @@ class TestBatch:
 
 
 class TestFallback:
-    def test_map_update_demotes_to_cpu(self):
+    def test_map_and_multiroot_stay_on_device(self):
         doc = make_doc(9)
         doc.get_map("m").set("k", 1)
+        doc.get_text("text").insert(0, "hi")
+        doc.get_text("notes").insert(0, "n0")
+        eng = BatchEngine(1)
+        eng.queue_update(0, Y.encode_state_as_update(doc))
+        eng.flush()
+        assert 0 not in eng.fallback
+        assert eng.text(0) == "hi"
+        assert eng.text(0, "notes") == "n0"
+        assert eng.map_json(0, "m") == {"k": 1}
+
+    def test_nested_type_demotes_to_cpu(self):
+        doc = make_doc(9)
+        inner = Y.YMap()
+        doc.get_map("m").set("nested", inner)  # ContentType -> CPU path
         doc.get_text("text").insert(0, "hi")
         eng = BatchEngine(1)
         eng.queue_update(0, Y.encode_state_as_update(doc))
@@ -267,9 +281,83 @@ class TestUpdateLogCompaction:
         assert len(eng._update_log[0]) <= 6
         assert_engine_matches(eng, doc)
         # demotion after compaction replays the snapshot + tail correctly
-        doc.get_map("m").set("k", 1)  # unsupported -> demote
+        doc.get_map("m").set("nested", Y.YMap())  # unsupported -> demote
         t.insert(0, "head ")
         eng.queue_update(0, Y.encode_state_as_update(doc, sv))
         eng.flush()
         assert 0 in eng.fallback
         assert eng.text(0) == t.to_string()
+
+
+class TestMapConvergence:
+    """Device-path YMap LWW (ported MAP_MODS fuzz, reference
+    tests/y-map.tests.js:438-481): random sets/deletes from several clients
+    under random delivery must converge to the CPU core's winners."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_map_ops(self, seed):
+        gen = random.Random(1000 + seed)
+        n_clients = gen.randint(2, 4)
+        docs = [make_doc(i + 1) for i in range(n_clients)]
+        upds = [collect_updates(d) for d in docs]
+        keys = ["a", "b", "c", "d"]
+        values = [0, 1, "s", 3.5, None, True, [1, 2], {"x": 1}]
+        for _ in range(35):
+            i = gen.randrange(n_clients)
+            m = docs[i].get_map("map")
+            if gen.random() < 0.8:
+                m.set(gen.choice(keys), gen.choice(values))
+            else:
+                m.delete(gen.choice(keys))
+            if gen.random() < 0.3:
+                src, dst = gen.randrange(n_clients), gen.randrange(n_clients)
+                for u in upds[src]:
+                    Y.apply_update(docs[dst], u)
+        all_updates = [u for us in upds for u in us]
+        gen.shuffle(all_updates)
+        for d in docs:
+            for u in all_updates:
+                Y.apply_update(d, u)
+        for d in docs[1:]:
+            assert d.get_map("map").to_json() == docs[0].get_map("map").to_json()
+        eng = replay_into_engine(all_updates)
+        assert not eng.has_pending(0)
+        assert eng.map_json(0, "map") == docs[0].get_map("map").to_json()
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_mixed_text_map_multiroot(self, seed):
+        gen = random.Random(2000 + seed)
+        n_clients = 3
+        docs = [make_doc(i + 1) for i in range(n_clients)]
+        upds = [collect_updates(d) for d in docs]
+        for _ in range(30):
+            i = gen.randrange(n_clients)
+            d = docs[i]
+            op = gen.random()
+            if op < 0.4:
+                t = d.get_text(gen.choice(["text", "notes"]))
+                ln = len(t.to_string())
+                if gen.random() < 0.7 or ln == 0:
+                    t.insert(gen.randint(0, ln), gen.choice(["x", "yy", "zz "]))
+                else:
+                    pos = gen.randrange(ln)
+                    t.delete(pos, min(gen.randint(1, 2), ln - pos))
+            elif op < 0.8:
+                d.get_map("map").set(gen.choice("abc"), gen.randrange(100))
+            else:
+                d.get_map("map").delete(gen.choice("abc"))
+            if gen.random() < 0.25:
+                src, dst = gen.randrange(n_clients), gen.randrange(n_clients)
+                for u in upds[src]:
+                    Y.apply_update(docs[dst], u)
+        all_updates = [u for us in upds for u in us]
+        gen.shuffle(all_updates)
+        for d in docs:
+            for u in all_updates:
+                Y.apply_update(d, u)
+        eng = replay_into_engine(all_updates)
+        ref = docs[0]
+        for name in ("text", "notes"):
+            assert eng.text(0, name) == ref.get_text(name).to_string()
+            assert_engine_matches(eng, ref, name=name)
+        assert eng.map_json(0, "map") == ref.get_map("map").to_json()

@@ -42,7 +42,7 @@ from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
 
 NULL = -1  # null id / null row sentinel in every int column
-# sched5 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
+# sched6 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
 NO_LEFT_WRITE = -3  # chain member: placed by its predecessor's succ write
 GATHER_SUCC = -2  # succ: the old successor of `check` (== right when fast)
 
@@ -272,40 +272,44 @@ class StepPlan:
     # splits of already-integrated rows: (orig_row, new_row), ordered so that
     # multiple cuts of one original row appear right-to-left
     splits: list[tuple[int, int]] = field(default_factory=list)
-    # integration schedule: (row, left_row, right_row) in causal order
-    sched: list[tuple[int, int, int]] = field(default_factory=list)
+    # integration schedule: (row, left_row, right_row, seg) in causal order
+    sched: list[tuple[int, int, int, int]] = field(default_factory=list)
     # rows to mark deleted after integration
     delete_rows: list[int] = field(default_factory=list)
-    # 5-field bulk schedule (row, left, right, check, succ) with dependency
-    # levels (1-based): see assign_levels
-    sched5: list[tuple[int, int, int, int, int]] = field(default_factory=list)
+    # 6-field bulk schedule (row, left, right, check, succ, seg) with
+    # dependency levels (1-based): see assign_levels
+    sched6: list[tuple[int, int, int, int, int, int]] = field(
+        default_factory=list
+    )
     levels: list[int] = field(default_factory=list)
     n_levels: int = 0
 
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
 
-        Items sharing a splice gap (same resolved left & right) necessarily
-        share (origin, rightOrigin) — post-split, a left row determines the
-        origin id and vice versa — so YATA orders them by ascending client
-        (reference Item.js case 1, :447-455).  The host pre-links each such
-        group into a chain spliced in ONE bulk write; remaining items get
-        one entry each.  Levels then only encode true causal depth: an
-        entry's level exceeds the level of the rows its gap depends on, and
-        no two entries in a level share a write target.
+        Items sharing a splice gap (same resolved left & right in the same
+        segment) necessarily share (origin, rightOrigin) — post-split, a
+        left row determines the origin id and vice versa — so YATA orders
+        them by ascending client (reference Item.js case 1, :447-455).  The
+        host pre-links each such group into a chain spliced in ONE bulk
+        write; remaining items get one entry each.  Levels then only encode
+        true causal depth: an entry's level exceeds the level of the rows
+        its gap depends on, and no two entries in a level share a write
+        target.
 
-        Each sched5 entry is (row, left, right, check, succ):
-        - fast iff rl[check] == right (check==NULL: head test st==right)
-        - splice: rl[left] = row (left>=0), st = row (left==NULL),
+        Each sched6 entry is (row, left, right, check, succ, seg):
+        - fast iff rl[check] == right (check==NULL: head test
+          starts[seg]==right)
+        - splice: rl[left] = row (left>=0), starts[seg] = row (left==NULL),
           rl[row] = succ, where succ==GATHER_SUCC means the gathered old
           successor of `check`
         - on fast-check failure the item integrates sequentially with
-          (row, check, right) — the original YATA inputs.
+          (row, check, right, seg) — the original YATA inputs.
         """
-        groups: dict[tuple[int, int], list[int]] = {}
-        order: list[tuple[int, int]] = []
-        for i, (row, left, right) in enumerate(self.sched):
-            key = (left, right)
+        groups: dict[tuple[int, int, int], list[int]] = {}
+        order: list[tuple[int, int, int]] = []
+        for i, (row, left, right, seg) in enumerate(self.sched):
+            key = (left, right, seg)
             g = groups.get(key)
             if g is None:
                 groups[key] = [i]
@@ -313,19 +317,22 @@ class StepPlan:
             else:
                 g.append(i)
 
-        self.sched5 = []
+        self.sched6 = []
         self.levels = []
         lev_of_row: dict[int, int] = {}
-        used: set[tuple[int, int]] = set()
+        used: set[tuple[int, object]] = set()
         n_levels = 0
         for key in order:
-            left, right = key
+            left, right, seg = key
             idxs = groups[key]
             members = [self.sched[i][0] for i in idxs]
             if len(members) > 1:
                 members.sort(key=client_of_row)
             base = 1 + max(lev_of_row.get(left, 0), lev_of_row.get(right, 0))
-            gap = left if left != NULL else -2
+            # write-target key: rl[left] for real lefts, the segment's head
+            # slot otherwise (distinct segments' head writes may share a
+            # level — they scatter to distinct starts[] cells)
+            gap: object = left if left != NULL else ("h", seg)
             lev = base
             while (lev, gap) in used:
                 lev += 1
@@ -333,18 +340,18 @@ class StepPlan:
             for j, row in enumerate(members):
                 entry_left = left if j == 0 else NO_LEFT_WRITE
                 succ = members[j + 1] if j + 1 < len(members) else GATHER_SUCC
-                self.sched5.append((row, entry_left, right, left, succ))
+                self.sched6.append((row, entry_left, right, left, succ, seg))
                 self.levels.append(lev)
                 lev_of_row[row] = lev
             n_levels = max(n_levels, lev)
         self.n_levels = n_levels
 
-    def packed_levels(self) -> list[list[tuple[int, int, int, int, int]]]:
-        """The 5-field schedule grouped level-major ([L, W, 5] device pack)."""
-        out: list[list[tuple[int, int, int, int, int]]] = [
+    def packed_levels(self) -> list[list[tuple[int, int, int, int, int, int]]]:
+        """The 6-field schedule grouped level-major ([L, W, 6] device pack)."""
+        out: list[list[tuple[int, int, int, int, int, int]]] = [
             [] for _ in range(self.n_levels)
         ]
-        for entry, lev in zip(self.sched5, self.levels):
+        for entry, lev in zip(self.sched6, self.levels):
             out[lev - 1].append(entry)
         return out
 
@@ -361,6 +368,16 @@ class DocMirror:
     fragment as a new row).  The per-client fragment index maps (client,
     clock) -> row for origin/rightOrigin resolution, the columnar analogue of
     StructStore.find (reference src/utils/StructStore.js:123-177).
+
+    Every (root type, map key) pair is a *segment*: an independent linked
+    list on device.  Segment ``(name, None)`` is the root list of a
+    YText/YArray/Xml root; ``(name, sub)`` is one YMap key's entry chain
+    (reference AbstractType _start vs _map, src/types/AbstractType.js:255-
+    288).  The same YATA kernel integrates both; the LWW rule for map
+    chains (reference Item.js:497-507 tail-delete + :512-516 mid-chain
+    self-delete, whose net effect is order-independent: every chain entry
+    except the final tail is deleted) is applied host-side because the
+    host replicates chain order anyway for exports.
     """
 
     def __init__(self, root_name: str = "text"):
@@ -368,6 +385,14 @@ class DocMirror:
         # client <-> dense slot mapping
         self.client_of_slot: list[int] = []
         self.slot_of_client: dict[int, int] = {}
+        # segment registry: (root name, parent_sub or None) -> seg id
+        self.segments: dict[tuple[str, str | None], int] = {}
+        self.seg_info: list[tuple[str, str | None]] = []
+        # per-map-segment host chain: rows in YATA order (tiny lists — one
+        # entry per concurrent writer of one key)
+        self.map_chain: dict[int, list[int]] = {}
+        # rows already LWW-deleted (dedup for DS bookkeeping)
+        self._lww_deleted: set[int] = set()
         # per-row columns (python lists; converted to numpy at flush)
         self.row_slot: list[int] = []
         self.row_clock: list[int] = []
@@ -380,6 +405,7 @@ class DocMirror:
         self.row_countable: list[bool] = []
         self.row_content: list[object | None] = []
         self.row_content_ref: list[int] = []
+        self.row_seg: list[int] = []  # segment id (NULL for GC rows)
         # per-slot fragment index, sorted by clock
         self.frag_clock: list[list[int]] = []
         self.frag_row: list[list[int]] = []
@@ -416,10 +442,28 @@ class DocMirror:
     def n_rows(self) -> int:
         return len(self.row_slot)
 
+    # -- segments -----------------------------------------------------------
+
+    def seg(self, name: str, sub: str | None = None) -> int:
+        key = (name, sub)
+        s = self.segments.get(key)
+        if s is None:
+            s = len(self.seg_info)
+            self.segments[key] = s
+            self.seg_info.append(key)
+        return s
+
+    @property
+    def n_segs(self) -> int:
+        return len(self.seg_info)
+
+    def seg_is_map(self, seg: int) -> bool:
+        return self.seg_info[seg][1] is not None
+
     # -- row / fragment bookkeeping ----------------------------------------
 
     def _add_row(self, slot, clock, length, origin, right_origin, is_gc, content,
-                 content_ref=0):
+                 content_ref=0, seg=NULL):
         row = len(self.row_slot)
         self.row_slot.append(slot)
         self.row_clock.append(clock)
@@ -442,6 +486,7 @@ class DocMirror:
         self.row_countable.append(not is_gc and content_ref not in (0, 1, 6))
         self.row_content.append(content)
         self.row_content_ref.append(content_ref)
+        self.row_seg.append(NULL if is_gc else seg)
         if is_gc:
             # GC structs are always deleted: they belong in the derived
             # DeleteSet (reference DeleteSet.js createDeleteSetFromStructStore)
@@ -485,6 +530,7 @@ class DocMirror:
         row = self.frag_row[slot][frag_idx]
         offset = at_clock - self.row_clock[row]
         right_content = self.realized_content(row).splice(offset)
+        seg = self.row_seg[row]
         new_row = self._add_row(
             slot,
             at_clock,
@@ -494,9 +540,16 @@ class DocMirror:
             False,
             right_content,
             self.row_content_ref[row],
+            seg=seg,
         )
         self.row_len[row] = offset
         plan.splits.append((row, new_row))
+        if seg != NULL and self.seg_is_map(seg):
+            # fragments of a map-chain entry sit adjacent in its chain
+            chain = self.map_chain[seg]
+            chain.insert(chain.index(row) + 1, new_row)
+            if row in self._lww_deleted:
+                self._lww_deleted.add(new_row)
         return new_row
 
     def _right_origin_of(self, row: int):
@@ -513,20 +566,96 @@ class DocMirror:
     def _check_supported(self, ref: ItemRef) -> None:
         if ref.is_gc:
             return
-        if ref.parent_id is not None or ref.parent_sub is not None:
-            raise UnsupportedUpdate("nested parent / map entry")
-        if ref.parent_name is not None and ref.parent_name != self.root_name:
-            raise UnsupportedUpdate(f"root type {ref.parent_name!r}")
+        if ref.parent_id is not None:
+            raise UnsupportedUpdate("nested type parent")
         if ref.content_ref in (7, 9):  # ContentType / ContentDoc
             raise UnsupportedUpdate(f"content ref {ref.content_ref}")
+
+    # -- map-chain host bookkeeping ----------------------------------------
+
+    def _origin_row(self, row: int) -> int:
+        """The row containing ``row``'s origin id (NULL if no origin)."""
+        s = self.row_origin_slot[row]
+        if s == NULL:
+            return NULL
+        fi = self._frag_containing(s, self.row_origin_clock[row])
+        return NULL if fi is None else self.frag_row[s][fi]
+
+    def _row_origin_eq(self, a: int, b: int) -> bool:
+        sa, sb = self.row_origin_slot[a], self.row_origin_slot[b]
+        return sa == sb and (
+            sa == NULL or self.row_origin_clock[a] == self.row_origin_clock[b]
+        )
+
+    def _row_right_eq(self, a: int, b: int) -> bool:
+        sa, sb = self.row_right_slot[a], self.row_right_slot[b]
+        return sa == sb and (
+            sa == NULL or self.row_right_clock[a] == self.row_right_clock[b]
+        )
+
+    def _chain_insert(self, seg: int, row: int, left_row: int, right_row: int):
+        """Insert a new map entry at its YATA position in the segment chain —
+        the host twin of the device conflict scan (reference Item.js:447-470)
+        over the tiny per-key chain, so LWW deletes and map exports need no
+        device readback."""
+        chain = self.map_chain.setdefault(seg, [])
+        li = chain.index(left_row) if left_row != NULL else -1
+        items_before: set[int] = set()
+        conflicting: set[int] = set()
+        left_i = li
+        i = li + 1
+        while i < len(chain):
+            o = chain[i]
+            if o == right_row:
+                break
+            items_before.add(o)
+            conflicting.add(o)
+            if self._row_origin_eq(row, o):
+                if self._row_client(o) < self._row_client(row):
+                    left_i = i
+                    conflicting.clear()
+                elif self._row_right_eq(row, o):
+                    break
+            else:
+                oor = self._origin_row(o)
+                if oor != NULL and oor in items_before:
+                    if oor not in conflicting:
+                        left_i = i
+                        conflicting.clear()
+                else:
+                    break
+            i += 1
+        chain.insert(left_i + 1, row)
+
+    def _row_client(self, row: int) -> int:
+        return self.client_of_slot[self.row_slot[row]]
+
+    def _lww_pass(self, segs: set[int], plan: StepPlan) -> None:
+        """Delete every map-chain entry except the final tail (the
+        order-independent net effect of reference Item.js:497-507 +
+        :512-516) for each segment touched this step."""
+        for seg in segs:
+            chain = self.map_chain.get(seg)
+            if not chain:
+                continue
+            tail = chain[-1]
+            for r in chain:
+                if r != tail and r not in self._lww_deleted:
+                    self._lww_deleted.add(r)
+                    plan.delete_rows.append(r)
+                    self._note_deleted(
+                        self.row_slot[r], self.row_clock[r], self.row_len[r]
+                    )
 
     # -- the flush pipeline -------------------------------------------------
 
     def prepare_step(self) -> StepPlan:
         """Consume queued updates and produce the device step plan.
 
-        Raises :class:`UnsupportedUpdate` (before mutating any state) if an
-        incoming ref is outside the device path's scope.
+        Raises :class:`UnsupportedUpdate` if an incoming ref is outside the
+        device path's scope (nested types, subdocuments).  The mirror may
+        be left mid-step in that case — the engine demotes the doc by
+        replaying its update log into a CPU Doc and discards the mirror.
         """
         incoming: dict[int, list[ItemRef]] = {}
         ds_ranges: list[tuple[int, int, int]] = list(self.pending_ds)
@@ -660,6 +789,7 @@ class DocMirror:
         )
 
         # -- row assignment + pointer resolution ---------------------------
+        touched_map_segs: set[int] = set()
         for ref in frag_sched:
             slot = self.slot(ref.client)
             if ref.is_gc:
@@ -686,11 +816,24 @@ class DocMirror:
             if degrade:
                 self._add_row(slot, ref.clock, ref.length, None, None, True, None)
                 continue
+            # segment: explicit parent, else copied from the neighbour the
+            # wire omitted it for (reference encoding.js canCopyParentInfo)
+            if ref.parent_name is not None:
+                seg = self.seg(ref.parent_name, ref.parent_sub)
+            elif left_row != NULL:
+                seg = self.row_seg[left_row]
+            elif right_row != NULL:
+                seg = self.row_seg[right_row]
+            else:
+                raise UnsupportedUpdate("item with no derivable parent")
             row = self._add_row(
                 slot, ref.clock, ref.length, ref.origin, ref.right_origin, False,
-                ref.content, ref.content_ref,
+                ref.content, ref.content_ref, seg=seg,
             )
-            plan.sched.append((row, left_row, right_row))
+            plan.sched.append((row, left_row, right_row, seg))
+            if self.seg_is_map(seg):
+                self._chain_insert(seg, row, left_row, right_row)
+                touched_map_segs.add(seg)
             if ref.content_ref == 1:  # ContentDeleted
                 applicable.append((ref.client, ref.clock, ref.length))
 
@@ -708,11 +851,17 @@ class DocMirror:
                 row = fr[i]
                 if fc[i] >= clock and not self.row_is_gc[row]:
                     plan.delete_rows.append(row)
+                    sg = self.row_seg[row]
+                    if sg != NULL and self.seg_is_map(sg):
+                        # host twin of the deleted bit for map entries so
+                        # map exports need no device readback
+                        self._lww_deleted.add(row)
                 i += 1
             self._note_deleted(slot, clock, ln)
 
+        self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
-        plan.assign_levels(lambda r: self.client_of_slot[self.row_slot[r]])
+        plan.assign_levels(self._row_client)
         return plan
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
@@ -720,6 +869,24 @@ class DocMirror:
         ranges.append((clock, ln))
 
     # -- exports ------------------------------------------------------------
+
+    def map_json(self, name: str) -> dict:
+        """The visible {key: value} of a root YMap — value = the final chain
+        tail's last content element (reference typeMapGet,
+        src/types/AbstractType.js:839-845)."""
+        out = {}
+        for (n, sub), seg in self.segments.items():
+            if n != name or sub is None:
+                continue
+            chain = self.map_chain.get(seg)
+            if not chain:
+                continue
+            tail = chain[-1]
+            if tail in self._lww_deleted:
+                continue
+            content = self.realized_content(tail)
+            out[sub] = content.get_content()[-1]
+        return out
 
     def state_vector(self) -> dict[int, int]:
         return {
@@ -813,8 +980,14 @@ class DocMirror:
             if rslot != NULL
             else None
         )
+        name, sub = self.seg_info[self.row_seg[row]]
         ref = self.row_content_ref[row]
-        info = ref | (0 if origin is None else BIT8) | (0 if right is None else BIT7)
+        info = (
+            ref
+            | (0 if origin is None else BIT8)
+            | (0 if right is None else BIT7)
+            | (0 if sub is None else BIT6)
+        )
         encoder.write_info(info)
         if origin is not None:
             encoder.write_left_id(origin)
@@ -822,7 +995,9 @@ class DocMirror:
             encoder.write_right_id(right)
         if origin is None and right is None:
             encoder.write_parent_info(True)  # device rows parent = root type
-            encoder.write_string(self.root_name)
+            encoder.write_string(name)
+            if sub is not None:
+                encoder.write_string(sub)
         self.realized_content(row).write(encoder, offset)
 
     def origin_rows(self) -> np.ndarray:

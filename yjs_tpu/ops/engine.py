@@ -3,9 +3,10 @@
 The device-side half of the `y-tpu` Provider described in BASELINE.json's
 north star: pending binary updates from many docs are marshalled into
 struct-of-arrays columns (:mod:`.columns`), integrated by the vmapped YATA
-kernel (:mod:`.kernels`), and the persistent device state (links, list head,
-deleted bits) lives across flushes.  Docs whose updates fall outside the
-device path's scope (nested types, map entries, subdocs) transparently fall
+kernel (:mod:`.kernels`), and the persistent device state (links, segment
+heads, deleted bits) lives across flushes.  Root text/list types, multiple
+roots, and root YMaps are all served on device; docs whose updates fall
+outside the device path's scope (nested types, subdocs) transparently fall
 back to the CPU reference core — the Provider gating seam.
 """
 
@@ -64,9 +65,10 @@ class BatchEngine:
     Parameters
     ----------
     n_docs: batch size.
-    root_name: the single root list/text type the device path supports
-        (reference YText over ContentString/Format runs; everything else
-        falls back to the CPU core per doc).
+    root_name: the default root type for text()/rows_in_order() when no
+        name is passed; any number of root text/list/map types per doc are
+        integrated on device (nested types and subdocs fall back to the
+        CPU core per doc).
     """
 
     def __init__(self, n_docs: int, root_name: str = "text", mesh=None):
@@ -93,9 +95,10 @@ class BatchEngine:
         # persistent device state (no left-link array: order is ranked from
         # right links with a host-known membership mask)
         self._cap = 0  # row capacity N (arrays are [B, N+1] with scratch row)
+        self._seg_cap = 0  # segment capacity S (starts is [B, S+1])
         self._right = None
         self._deleted = None
-        self._start = None
+        self._starts = None
 
     # -- update ingestion ---------------------------------------------------
 
@@ -120,25 +123,30 @@ class BatchEngine:
 
     # -- device state management -------------------------------------------
 
-    def _ensure_capacity(self, n_rows: int) -> None:
+    def _ensure_capacity(self, n_rows: int, n_segs: int) -> None:
         cap = _bucket(n_rows)
-        if cap <= self._cap and self._right is not None:
+        seg_cap = _bucket(n_segs, 8)
+        if (
+            cap <= self._cap
+            and seg_cap <= self._seg_cap
+            and self._right is not None
+        ):
             return
         b = self.n_docs
-        old_cap = self._cap
-        self._cap = cap
-        new_right = np.full((b, cap + 1), NULL, np.int32)
-        new_deleted = np.zeros((b, cap + 1), bool)
+        old_cap, old_seg = self._cap, self._seg_cap
+        self._cap = max(cap, self._cap)
+        self._seg_cap = max(seg_cap, self._seg_cap)
+        new_right = np.full((b, self._cap + 1), NULL, np.int32)
+        new_deleted = np.zeros((b, self._cap + 1), bool)
+        new_starts = np.full((b, self._seg_cap + 1), NULL, np.int32)
         if self._right is not None:
             # old scratch region is reset to NULL by the fresh allocation
             new_right[:, :old_cap] = np.asarray(self._right)[:, :old_cap]
             new_deleted[:, :old_cap] = np.asarray(self._deleted)[:, :old_cap]
-            start = np.asarray(self._start)
-        else:
-            start = np.full((b,), NULL, np.int32)
+            new_starts[:, :old_seg] = np.asarray(self._starts)[:, :old_seg]
         self._right = jnp.asarray(new_right)
         self._deleted = jnp.asarray(new_deleted)
-        self._start = jnp.asarray(start)
+        self._starts = jnp.asarray(new_starts)
 
     # -- flush: run one device integration step ----------------------------
 
@@ -162,14 +170,17 @@ class BatchEngine:
             max((len(lv) for pk in packed.values() for lv in pk), default=0), 1
         )
         max_rows = max((p.n_rows for p in plans.values()), default=0)
+        max_segs = max(
+            (self.mirrors[i].n_segs for i in plans), default=0
+        )
         # reserve >= 2*w_lv spare row slots per doc: the level kernel's
         # merged scatter uses two unique scratch lanes per schedule slot
-        self._ensure_capacity(max_rows + 2 * w_lv)
+        self._ensure_capacity(max_rows + 2 * w_lv, max_segs)
         b, cap = self.n_docs, self._cap
 
         splits = np.full((b, n_splits, 2), NULL, np.int32)
-        sched = np.full((b, n_sched, 3), NULL, np.int32)
-        lv_sched = np.full((b, n_lv, w_lv, 5), NULL, np.int32)
+        sched = np.full((b, n_sched, 4), NULL, np.int32)
+        lv_sched = np.full((b, n_lv, w_lv, 6), NULL, np.int32)
         dels = np.full((b, n_del), NULL, np.int32)
         statics = {
             "client_key": np.zeros((b, cap + 1), np.uint32),
@@ -201,7 +212,7 @@ class BatchEngine:
             scratch_base[i] = p.n_rows
 
         statics = {k: jnp.asarray(v) for k, v in statics.items()}
-        dyn = (self._right, self._deleted, self._start)
+        dyn = (self._right, self._deleted, self._starts)
         if self._sharded_step is not None:
             # keep metrics as device scalars: converting here would block the
             # async dispatch and serialize host transcode with device compute
@@ -219,7 +230,7 @@ class BatchEngine:
                 statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
                 jnp.asarray(dels), jnp.asarray(scratch_base),
             )
-        self._right, self._deleted, self._start = new_dyn
+        self._right, self._deleted, self._starts = new_dyn
 
         # compact long demotion-replay logs: once a doc's integrated state is
         # pending-free, its own columnar export supersedes the raw update
@@ -247,15 +258,15 @@ class BatchEngine:
             return {c: v for c, v in get_state_vector(fb.store).items()}
         return self.mirrors[doc].state_vector()
 
-    def _order(self, doc: int) -> tuple[np.ndarray, np.ndarray]:
-        """Document-order row ids + deleted flags for one doc."""
+    def _order(self, doc: int, seg: int) -> tuple[np.ndarray, np.ndarray]:
+        """Segment-order row ids + deleted flags for one doc's segment."""
         if self._right is None:
             return np.zeros(0, np.int64), np.zeros(0, bool)
         m = self.mirrors[doc]
         valid_host = np.zeros(self._right.shape[1], bool)
         n = m.n_rows
         if n:
-            valid_host[:n] = ~np.asarray(m.row_is_gc[:n], bool)
+            valid_host[:n] = np.asarray(m.row_seg[:n], np.int32) == seg
         d = np.asarray(
             kernels.list_ranks(self._right[doc : doc + 1], jnp.asarray(valid_host)[None])
         )[0]
@@ -265,19 +276,25 @@ class BatchEngine:
         rows = rows[np.argsort(-d[rows], kind="stable")]
         return rows, deleted[rows]
 
-    def rows_in_order(self, doc: int) -> list[tuple[int, int, int, bool]]:
-        """(client, clock, length, deleted) per row in document order — the
-        convergence-oracle view (mirrors compare_struct_stores in tests)."""
+    def rows_in_order(
+        self, doc: int, name: str | None = None
+    ) -> list[tuple[int, int, int, bool]]:
+        """(client, clock, length, deleted) per row in list order of one root
+        type — the convergence-oracle view (mirrors compare_struct_stores)."""
+        name = name or self.root_name
         fb = self.fallback.get(doc)
         if fb is not None:
             out = []
-            item = fb.get_text(self.root_name)._start
+            item = fb.get_text(name)._start
             while item is not None:
                 out.append((item.id.client, item.id.clock, item.length, item.deleted))
                 item = item.right
             return out
         m = self.mirrors[doc]
-        rows, dels = self._order(doc)
+        seg = m.segments.get((name, None))
+        if seg is None:
+            return []
+        rows, dels = self._order(doc, seg)
         return [
             (
                 m.client_of_slot[m.row_slot[r]],
@@ -288,13 +305,27 @@ class BatchEngine:
             for r, d in zip(rows, dels)
         ]
 
-    def text(self, doc: int) -> str:
-        """Materialize the root text content of one doc."""
+    def text(self, doc: int, name: str | None = None) -> str:
+        """Materialize the content of one root text/list type."""
+        name = name or self.root_name
         fb = self.fallback.get(doc)
         if fb is not None:
-            return fb.get_text(self.root_name).to_string()
-        rows, dels = self._order(doc)
-        return visible_text(self.mirrors[doc], rows, dels)
+            return fb.get_text(name).to_string()
+        m = self.mirrors[doc]
+        seg = m.segments.get((name, None))
+        if seg is None:
+            return ""
+        rows, dels = self._order(doc, seg)
+        return visible_text(m, rows, dels)
+
+    def map_json(self, doc: int, name: str | None = None) -> dict:
+        """The visible {key: value} content of one root YMap (LWW winners,
+        reference typeMapGet / YMap.toJSON)."""
+        name = name or self.root_name
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return fb.get_map(name).to_json()
+        return self.mirrors[doc].map_json(name)
 
     def encode_state_vector(self, doc: int) -> bytes:
         fb = self.fallback.get(doc)

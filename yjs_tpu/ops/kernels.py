@@ -55,24 +55,19 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
 
     statics: dict of [N+1] columns (client_key u32, origin_slot/clock,
         right_slot/clock, origin_row  i32)
-    dyn: (right_link[N+1], deleted[N+1], start — i32/bool; no left-link
-        array: the head test is start==row and document order is ranked
-        from right links alone)
+    dyn: (right_link[N+1], deleted[N+1], starts[S+1]) — starts holds each
+        segment's list head (root lists and per-map-key chains alike); no
+        left-link array: the head test is starts[seg]==row and document
+        order is ranked from right links alone
     splits: [S, 2] i32 (orig_row, new_row), NULL-padded, right-to-left per
         original row
-    sched: [M, 3] i32 (row, left_row, right_row), NULL-padded, causal order
+    sched: [M, 4] i32 (row, left_row, right_row, seg), NULL-padded, causal
+        order
     delete_rows: [D] i32, NULL-padded
     """
-    right_link, deleted, start = dyn
+    right_link, deleted, starts = dyn
     n1 = right_link.shape[0]
     dummy = n1 - 1
-
-    client_key = statics["client_key"]
-    oslot = statics["origin_slot"]
-    oclock = statics["origin_clock"]
-    rslot = statics["right_slot"]
-    rclock = statics["right_clock"]
-    origin_row = statics["origin_row"]
 
     # -- split pre-pass: link surgery for host-computed run splits ----------
     # (the device half of splitItem, reference src/structs/Item.js:84-120)
@@ -95,15 +90,15 @@ def _doc_step(statics, dyn, splits, sched, delete_rows):
     integrate_item = _make_integrate_item(statics, dummy)
 
     def integ_body(carry, s):
-        carry = integrate_item(carry, s[0], s[1], s[2])
+        carry = integrate_item(carry, s[0], s[1], s[2], s[3])
         return carry, None
 
-    (right_link, start), _ = lax.scan(
-        integ_body, (right_link, start), sched
+    (right_link, starts), _ = lax.scan(
+        integ_body, (right_link, starts), sched
     )
 
     deleted = _apply_deletes(deleted, delete_rows, dummy)
-    return right_link, deleted, start
+    return right_link, deleted, starts
 
 
 def _make_integrate_item(statics, dummy):
@@ -117,9 +112,12 @@ def _make_integrate_item(statics, dummy):
     rclock = statics["right_clock"]
     origin_row = statics["origin_row"]
 
-    def integrate_item(carry, k, left0, right0):
-        rl, st = carry
+    def integrate_item(carry, k, left0, right0, seg):
+        rl, starts = carry
         n1 = rl.shape[0]
+        s_dummy = starts.shape[0] - 1
+        safe_seg = jnp.where(seg >= 0, seg, s_dummy)
+        st = starts[safe_seg]  # this segment's list head
         # per-scan conflict sets: fresh visit marks, so no cross-scan counter
         visit = jnp.full((n1,), -1, jnp.int32)
         counter = jnp.int32(0)
@@ -186,13 +184,13 @@ def _make_integrate_item(statics, dummy):
             ),
         )
 
-        # splice into the list (reference Item.js:473-489, list path)
+        # splice into the list (reference Item.js:473-489)
         safe_left = jnp.where(left >= 0, left, dummy)
         right2 = jnp.where(left == NULL, st, rl[safe_left])
         rl = _upd(rl, left, k, valid & (left != NULL), dummy)
-        st = jnp.where(valid & (left == NULL), k, st)
+        starts = _upd(starts, safe_seg, k, valid & (left == NULL), s_dummy)
         rl = _upd(rl, k, right2, valid, dummy)
-        return (rl, st)
+        return (rl, starts)
 
     return integrate_item
 
@@ -214,8 +212,8 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     indices (duplicate scatter indices serialize on TPU).  The engine
     guarantees >= W spare slots and masks phantom rows at export.
 
-    ``lv_sched`` is the 5-field schedule packed level-major, [L, W, 5]
-    NULL-padded rows of (row, left, right, check, succ); items in one
+    ``lv_sched`` is the 6-field schedule packed level-major, [L, W, 6]
+    NULL-padded rows of (row, left, right, check, succ, seg); items in one
     dependency level (host-assigned, see StepPlan.assign_levels) have
     distinct splice gaps and already-placed deps, so every fast-path item
     in a level splices in ONE vectorized pass; items sharing a gap are
@@ -225,9 +223,10 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     back to the sequential YATA scan.  Collapses the per-item lax.scan of
     `_doc_step` (~#items steps) into ~#levels steps of width ~W.
     """
-    right_link, deleted, start = dyn
+    right_link, deleted, starts = dyn
     n1 = right_link.shape[0]
     dummy = n1 - 1
+    s_dummy = starts.shape[0] - 1
 
     # split pre-pass (identical to _doc_step)
     def split_body(carry, instr):
@@ -248,21 +247,24 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     integrate_item = _make_integrate_item(statics, dummy)
 
     def level_body(carry, lv):
-        rl, st = carry
+        rl, starts = carry
         k = lv[:, 0]
         l0 = lv[:, 1]  # left write target; NULL = head, NO_LEFT_WRITE = chained
         r0 = lv[:, 2]
         chk = lv[:, 3]  # shared gap left (NULL = head gap)
         succ = lv[:, 4]  # next chain member, or GATHER_SUCC = old gap successor
+        seg = lv[:, 5]  # segment (root list / map-key chain) of the row
         w = k.shape[0]
         mask = k >= 0
         safe_chk = jnp.where(chk >= 0, chk, dummy)
+        safe_seg = jnp.where(seg >= 0, seg, s_dummy)
+        st = starts[safe_seg]  # per-lane segment head
 
         # vectorized fast-path check across the level: the splice gap is
         # intact iff the gap-left's successor is still exactly `right`
-        # (head gap: st == r0 — covers the empty-list r0==NULL case too).
-        # All members of one chain share (chk, r0), so a chain is fast or
-        # deferred as a whole.
+        # (head gap: starts[seg] == r0 — covers the empty-segment r0==NULL
+        # case too).  All members of one chain share (chk, r0), so a chain
+        # is fast or deferred as a whole.
         fast = mask & jnp.where(chk == NULL, st == r0, rl[safe_chk] == r0)
 
         # bulk splice of all fast items (gaps are distinct by construction):
@@ -282,11 +284,12 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
             jnp.where(fast, succ_v, NULL),
         ])
         rl = rl.at[idx].set(val, unique_indices=True)
-        head_k = jnp.max(jnp.where(fast & (l0 == NULL), k, NULL))
-        st = jnp.where(head_k >= 0, head_k, st)
+        # head writes: one segment head at most per (level, seg) by
+        # construction; masked lanes pile onto the scratch cell (junk)
+        starts = _upd(starts, seg, k, fast & (l0 == NULL), s_dummy)
 
         # deferred: true conflicts run the sequential YATA scan one by one
-        # with the original YATA inputs (row, gap-left, right); chain
+        # with the original YATA inputs (row, gap-left, right, seg); chain
         # members are processed in ascending-client order (their index
         # order), which the conflict scan keeps correct
         pending = mask & ~fast
@@ -298,22 +301,22 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
         def defer_body(cs):
             pending, carry = cs
             j = jnp.argmax(pending)
-            carry = integrate_item(carry, k[j], chk[j], r0[j])
+            carry = integrate_item(carry, k[j], chk[j], r0[j], seg[j])
             return pending.at[j].set(False), carry
 
-        _, (rl, st) = lax.while_loop(
-            defer_cond, defer_body, (pending, (rl, st))
+        _, (rl, starts) = lax.while_loop(
+            defer_cond, defer_body, (pending, (rl, starts))
         )
-        return (rl, st), None
+        return (rl, starts), None
 
-    (right_link, start), _ = lax.scan(
+    (right_link, starts), _ = lax.scan(
         level_body,
-        (right_link, start),
+        (right_link, starts),
         lv_sched,
     )
 
     deleted = _apply_deletes(deleted, delete_rows, dummy)
-    return right_link, deleted, start
+    return right_link, deleted, starts
 
 
 @functools.partial(jax.jit, donate_argnums=(1,))
@@ -329,7 +332,7 @@ def batch_step(statics, dyn, splits, sched, delete_rows):
 def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     """vmapped level-parallel integration step (the default engine path).
 
-    lv_sched: [B, L, W, 5] level-major sched5 schedule, NULL-padded.
+    lv_sched: [B, L, W, 6] level-major sched6 schedule, NULL-padded.
     scratch_base: [B] i32 per-doc row count (see _doc_step_levels).
     """
     return jax.vmap(_doc_step_levels)(
