@@ -361,3 +361,73 @@ class TestMapConvergence:
             assert eng.text(0, name) == ref.get_text(name).to_string()
             assert_engine_matches(eng, ref, name=name)
         assert eng.map_json(0, "map") == ref.get_map("map").to_json()
+
+
+class TestCompaction:
+    """Run-merge + GC keep the device table bounded (VERDICT item 3; the
+    engine-side analogue of reference Transaction.js:165-238,299-332)."""
+
+    def _long_append_trace(self, eng, doc, n_flushes, per_flush=20):
+        t = doc.get_text("text")
+        sv = None
+        for _ in range(n_flushes):
+            for _ in range(per_flush):
+                t.insert(len(t.to_string()), "w ")
+            u = Y.encode_state_as_update(doc, sv)
+            sv = Y.encode_state_vector(doc)
+            eng.queue_update(0, u)
+            eng.flush()
+
+    def test_append_trace_rows_bounded(self):
+        doc = make_doc(41)
+        eng = BatchEngine(1, compact_min_rows=64)
+        self._long_append_trace(eng, doc, 80)  # 1600 inserts, 80 flushes
+        m = eng.mirrors[0]
+        # contiguous same-client typing collapses to a handful of runs
+        assert m.n_rows < 100, m.n_rows
+        assert eng.last_compaction is not None
+        assert_engine_matches(eng, doc)
+
+    def test_delete_heavy_trace_with_gc(self):
+        doc = make_doc(42)
+        eng = BatchEngine(1, gc=True, compact_min_rows=64)
+        t = doc.get_text("text")
+        sv = None
+        for step in range(40):
+            for _ in range(15):
+                t.insert(len(t.to_string()), "xy")
+            t.delete(0, len(t.to_string()) - 4)  # tombstone almost everything
+            u = Y.encode_state_as_update(doc, sv)
+            sv = Y.encode_state_vector(doc)
+            eng.queue_update(0, u)
+            eng.flush()
+        m = eng.mirrors[0]
+        assert m.n_rows < 120, m.n_rows
+        # gc dropped tombstone payloads: deleted rows are ContentDeleted
+        from yjs_tpu.core import ContentDeleted
+        n_tombstone = sum(
+            1 for c in m.row_content if isinstance(c, ContentDeleted)
+        )
+        assert n_tombstone > 0
+        assert eng.text(0) == t.to_string()
+
+    def test_convergence_after_compaction(self):
+        """Edits arriving after a compaction must still integrate and sync
+        correctly (origins point inside merged runs -> re-split)."""
+        doc = make_doc(43)
+        eng = BatchEngine(1, compact_min_rows=64)
+        self._long_append_trace(eng, doc, 30)
+        # a second client edits concurrently against the synced state
+        remote = make_doc(900)
+        Y.apply_update(remote, Y.encode_state_as_update(doc))
+        remote.get_text("text").insert(5, "[mid]")
+        remote.get_text("text").delete(20, 6)
+        u = Y.encode_state_as_update(remote, Y.encode_state_vector(doc))
+        Y.apply_update(doc, u)
+        eng.queue_update(0, u)
+        eng.flush()
+        assert_engine_matches(eng, doc)
+        # and the mirror's wire export round-trips into a fresh CPU doc
+        fresh = Y.Doc(gc=False)
+        Y.apply_update(fresh, eng.encode_state_as_update(0))
+        assert fresh.get_text("text").to_string() == doc.get_text("text").to_string()

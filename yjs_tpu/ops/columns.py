@@ -870,6 +870,171 @@ class DocMirror:
 
     # -- exports ------------------------------------------------------------
 
+    # -- compaction ---------------------------------------------------------
+
+    def rebuild_compacted(self, right_link, deleted, head_of_seg, gc: bool):
+        """Merge adjacent runs and GC deleted payloads, renumbering rows.
+
+        The columnar analogue of the reference's in-transaction GC + merge
+        passes (tryGcDeleteSet / tryMergeDeleteSet / tryToMergeWithLeft,
+        src/utils/Transaction.js:165-238): ``right_link``/``deleted`` are
+        the device state read back for this doc, ``head_of_seg`` maps seg ->
+        head row.  GC (when enabled) replaces deleted rows' content with a
+        length-only tombstone (Item.gc parentGCd=false, Item.js:604-614);
+        the merge pass collapses list-adjacent, clock-contiguous,
+        origin-linked same-state rows (Item.mergeWith, Item.js:555-579).
+        Map-key chains are left unmerged (tiny by construction).
+
+        Returns (new_right, new_deleted, new_head_of_seg) numpy arrays over
+        the NEW row numbering for device re-upload.
+        """
+        from ..core import ContentDeleted
+
+        n = self.n_rows
+        # per-seg order by walking the read-back links
+        order_of_seg: dict[int, list[int]] = {}
+        for seg in range(self.n_segs):
+            head = int(head_of_seg[seg]) if seg < len(head_of_seg) else NULL
+            out = []
+            r = head
+            while r != NULL:
+                out.append(r)
+                r = int(right_link[r])
+            order_of_seg[seg] = out
+
+        # GC pass: deleted content -> tombstone (payload freed)
+        if gc:
+            for row in range(n):
+                if (
+                    not self.row_is_gc[row]
+                    and deleted[row]
+                    and self.row_content_ref[row] != 1
+                ):
+                    self.row_content[row] = ContentDeleted(self.row_len[row])
+                    self.row_content_ref[row] = 1
+                    self.row_countable[row] = False
+
+        # merge pass: list segments right-to-left; GC rows by clock order
+        absorbed: dict[int, int] = {}  # dead row -> surviving head row
+
+        def try_merge(a: int, b: int) -> bool:
+            if self.row_slot[a] != self.row_slot[b]:
+                return False
+            if self.row_clock[a] + self.row_len[a] != self.row_clock[b]:
+                return False
+            if bool(deleted[a]) != bool(deleted[b]):
+                return False
+            if self.row_is_gc[a] != self.row_is_gc[b]:
+                return False
+            if self.row_is_gc[a]:
+                return True  # GC runs merge on contiguity alone (GC.js:24-27)
+            # right.origin == this.lastId
+            if self.row_origin_slot[b] != self.row_slot[a] or (
+                self.row_origin_clock[b]
+                != self.row_clock[a] + self.row_len[a] - 1
+            ):
+                return False
+            if not self._row_right_eq(a, b):
+                return False
+            ca, cb = self.realized_content(a), self.realized_content(b)
+            if type(ca) is not type(cb) or not ca.merge_with(cb):
+                return False
+            return True
+
+        for seg, order in order_of_seg.items():
+            if self.seg_is_map(seg):
+                continue
+            i = 0
+            while i + 1 < len(order):
+                a, b = order[i], order[i + 1]
+                if try_merge(a, b):
+                    self.row_len[a] += self.row_len[b]
+                    absorbed[b] = a
+                    order.pop(i + 1)
+                else:
+                    i += 1
+        # GC structs: not in any list; merge contiguous runs per client
+        for slot in range(len(self.client_of_slot)):
+            prev = None
+            for row in self.frag_row[slot]:
+                if not self.row_is_gc[row] or row in absorbed:
+                    prev = None if not self.row_is_gc[row] else row
+                    continue
+                if prev is not None and try_merge(prev, row):
+                    self.row_len[prev] += self.row_len[row]
+                    absorbed[row] = prev
+                else:
+                    prev = row
+
+        # renumber surviving rows (order preserved: absorbed rows vanish)
+        new_of_old = np.full(n, NULL, np.int32)
+        keep = [r for r in range(n) if r not in absorbed]
+        for new, old in enumerate(keep):
+            new_of_old[old] = new
+        self._renumber(keep, new_of_old)
+
+        n_new = len(keep)
+        new_right = np.full(n_new, NULL, np.int32)
+        new_deleted = np.zeros(n_new, bool)
+        new_heads = np.full(max(1, self.n_segs), NULL, np.int32)
+        for old in keep:
+            new_deleted[new_of_old[old]] = bool(deleted[old])
+        for seg, order in order_of_seg.items():
+            prev = NULL
+            for old in order:
+                nr = new_of_old[old]
+                if prev == NULL:
+                    new_heads[seg] = nr
+                else:
+                    new_right[prev] = nr
+                prev = nr
+        return new_right, new_deleted, new_heads
+
+    def _renumber(self, keep: list[int], new_of_old: np.ndarray) -> None:
+        """Apply a row renumbering to every host-side structure."""
+        take = lambda col: [col[r] for r in keep]
+        self.row_slot = take(self.row_slot)
+        self.row_clock = take(self.row_clock)
+        self.row_len = take(self.row_len)
+        self.row_origin_slot = take(self.row_origin_slot)
+        self.row_origin_clock = take(self.row_origin_clock)
+        self.row_right_slot = take(self.row_right_slot)
+        self.row_right_clock = take(self.row_right_clock)
+        self.row_is_gc = take(self.row_is_gc)
+        self.row_countable = take(self.row_countable)
+        self.row_content = take(self.row_content)
+        self.row_content_ref = take(self.row_content_ref)
+        self.row_seg = take(self.row_seg)
+        # fragment index: rebuild from the surviving rows (clock-sorted)
+        n_slots = len(self.client_of_slot)
+        self.frag_clock = [[] for _ in range(n_slots)]
+        self.frag_row = [[] for _ in range(n_slots)]
+        by_slot: dict[int, list[int]] = {}
+        for row in range(len(self.row_slot)):
+            by_slot.setdefault(self.row_slot[row], []).append(row)
+        for slot, rows in by_slot.items():
+            rows.sort(key=lambda r: self.row_clock[r])
+            self.frag_clock[slot] = [self.row_clock[r] for r in rows]
+            self.frag_row[slot] = rows
+        self.map_chain = {
+            seg: [int(new_of_old[r]) for r in chain]
+            for seg, chain in self.map_chain.items()
+        }
+        self._lww_deleted = {
+            int(new_of_old[r]) for r in self._lww_deleted if new_of_old[r] != NULL
+        }
+        # compact the host DS ranges too (sort + merge, DeleteSet.js:113-135)
+        for slot, ranges in self.ds.items():
+            ranges.sort()
+            merged: list[tuple[int, int]] = []
+            for clock, ln in ranges:
+                if merged and clock <= merged[-1][0] + merged[-1][1]:
+                    last_c, last_l = merged[-1]
+                    merged[-1] = (last_c, max(last_l, clock + ln - last_c))
+                else:
+                    merged.append((clock, ln))
+            self.ds[slot] = merged
+
     def map_json(self, name: str) -> dict:
         """The visible {key: value} of a root YMap — value = the final chain
         tail's last content element (reference typeMapGet,

@@ -71,10 +71,23 @@ class BatchEngine:
         CPU core per doc).
     """
 
-    def __init__(self, n_docs: int, root_name: str = "text", mesh=None):
+    def __init__(
+        self,
+        n_docs: int,
+        root_name: str = "text",
+        mesh=None,
+        gc: bool = False,
+        compact_min_rows: int = 512,
+    ):
         self.n_docs = n_docs
         self.root_name = root_name
         self.mesh = mesh
+        self.gc = gc
+        self.compact_min_rows = compact_min_rows
+        # per-doc row count at the last compaction (growth trigger)
+        self._rows_at_compact = [0] * n_docs
+        # per-doc stats of the most recent flush's compactions
+        self.last_compaction: list[dict] | None = None
         self._metrics_dev: dict | None = None
         self._sharded_step = None
         if mesh is not None:
@@ -148,9 +161,54 @@ class BatchEngine:
         self._deleted = jnp.asarray(new_deleted)
         self._starts = jnp.asarray(new_starts)
 
+    # -- compaction ---------------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        """Amortized run-merge + GC: when a doc's table doubles since its
+        last compaction, read back its links/deleted bits and rebuild the
+        mirror + device state with adjacent runs merged (the engine-side
+        analogue of the reference's per-transaction merge/GC passes,
+        Transaction.js:165-238,299-332).  Keeps row count bounded by the
+        doc's true run structure instead of its edit history."""
+        todo = [
+            i
+            for i, m in enumerate(self.mirrors)
+            if i not in self.fallback
+            and m.n_rows >= max(self.compact_min_rows, 2 * self._rows_at_compact[i])
+        ]
+        if not todo or self._right is None:
+            return
+        # transfer only the compacting docs' rows (device gather), rebuild
+        # host-side, then scatter the rebuilt rows back — O(|todo| * N)
+        # traffic, not O(B * N)
+        idx = jnp.asarray(todo)
+        right = np.asarray(self._right[idx])
+        deleted = np.asarray(self._deleted[idx])
+        starts = np.asarray(self._starts[idx])
+        new_right = np.full_like(right, NULL)
+        new_deleted = np.zeros_like(deleted)
+        new_starts = np.full_like(starts, NULL)
+        self.last_compaction = []
+        for j, i in enumerate(todo):
+            m = self.mirrors[i]
+            old_n = m.n_rows
+            r, d, h = m.rebuild_compacted(right[j], deleted[j], starts[j], self.gc)
+            n_new = len(r)
+            new_right[j, :n_new] = r
+            new_deleted[j, :n_new] = d
+            new_starts[j, : len(h)] = h
+            self._rows_at_compact[i] = n_new
+            self.last_compaction.append(
+                {"doc": i, "rows_before": old_n, "rows_after": n_new}
+            )
+        self._right = self._right.at[idx].set(new_right)
+        self._deleted = self._deleted.at[idx].set(new_deleted)
+        self._starts = self._starts.at[idx].set(new_starts)
+
     # -- flush: run one device integration step ----------------------------
 
     def flush(self) -> None:
+        self._maybe_compact()
         plans = {}
         for i, m in enumerate(self.mirrors):
             if i in self.fallback:

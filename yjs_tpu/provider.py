@@ -23,8 +23,10 @@ from .sync import protocol
 class TpuProvider:
     """Batched multi-doc provider backed by :class:`BatchEngine`."""
 
-    def __init__(self, n_docs: int, root_name: str = "text", mesh=None):
-        self.engine = BatchEngine(n_docs, root_name=root_name, mesh=mesh)
+    def __init__(
+        self, n_docs: int, root_name: str = "text", mesh=None, gc: bool = False
+    ):
+        self.engine = BatchEngine(n_docs, root_name=root_name, mesh=mesh, gc=gc)
         self._guids: dict[str, int] = {}
         self._next = 0
         self._dirty = False
