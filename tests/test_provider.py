@@ -135,3 +135,66 @@ def _apply_step2(doc, reply):
     from yjs_tpu.sync import protocol
 
     protocol.read_sync_message(Decoder(reply), Encoder(), doc)
+
+
+class TestUpdateEmission:
+    """VERDICT item 7: after flush() the engine emits per-doc incremental
+    updates (reference Transaction.js:339-352) so a server can broadcast
+    to peers; a third replica stays in sync purely from emitted updates."""
+
+    def test_observer_replica_syncs_from_emissions_only(self):
+        gen = random.Random(7)
+        prov = TpuProvider(2)
+        observer = Y.Doc(gc=False)
+        observer.client_id = 999
+        prov.on_update(
+            lambda guid, u: Y.apply_update(observer, u) if guid == "room" else None
+        )
+        a = Y.Doc(gc=False)
+        a.client_id = 1
+        b = Y.Doc(gc=False)
+        b.client_id = 2
+        pending = []
+        for d in (a, b):
+            d.on("update", lambda u, o, dd: pending.append(u))
+        for step in range(30):
+            client_edit(gen, gen.choice((a, b)))
+            a_map = a.get_map("meta")
+            if gen.random() < 0.3:
+                a_map.set(gen.choice("xyz"), step)
+            if gen.random() < 0.5 and pending:
+                gen.shuffle(pending)
+                for u in pending:
+                    prov.receive_update("room", u)
+                pending.clear()
+                prov.flush()
+        for u in pending:
+            prov.receive_update("room", u)
+        prov.flush()
+        # the observer NEVER talked to the provider: emissions only
+        i = prov.doc_id("room")
+        assert observer.get_text("text").to_string() == prov.text("room")
+        assert observer.get_map("meta").to_json() == prov.engine.map_json(i, "meta")
+        assert not observer.store.pending_clients_struct_refs
+        assert not observer.store.pending_stack
+
+    def test_emission_after_demotion_keeps_flowing(self):
+        prov = TpuProvider(2)
+        observer = Y.Doc(gc=False)
+        observer.client_id = 998
+        prov.on_update(lambda guid, u: Y.apply_update(observer, u))
+        d = Y.Doc(gc=False)
+        d.client_id = 3
+        d.get_text("text").insert(0, "pre ")
+        prov.receive_update("r", Y.encode_state_as_update(d))
+        prov.flush()
+        # demote mid-stream with a nested type, then keep editing
+        d.get_map("m").set("nested", Y.YMap())
+        sv = Y.encode_state_vector(d)
+        prov.receive_update("r", Y.encode_state_as_update(d, None))
+        prov.flush()
+        assert prov.n_fallback_docs == 1
+        d.get_text("text").insert(4, "post")
+        prov.receive_update("r", Y.encode_state_as_update(d, sv))
+        prov.flush()
+        assert observer.get_text("text").to_string() == d.get_text("text").to_string()

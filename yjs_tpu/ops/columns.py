@@ -276,6 +276,9 @@ class StepPlan:
     sched: list[tuple[int, int, int, int]] = field(default_factory=list)
     # rows to mark deleted after integration
     delete_rows: list[int] = field(default_factory=list)
+    # delete ranges applied this step (client, clock, len) — the DS section
+    # of the step's emitted incremental update
+    applied_ds: list[tuple[int, int, int]] = field(default_factory=list)
     # 6-field bulk schedule (row, left, right, check, succ, seg) with
     # dependency levels (1-based): see assign_levels
     sched6: list[tuple[int, int, int, int, int, int]] = field(
@@ -646,6 +649,9 @@ class DocMirror:
                     self._note_deleted(
                         self.row_slot[r], self.row_clock[r], self.row_len[r]
                     )
+                    plan.applied_ds.append(
+                        (self._row_client(r), self.row_clock[r], self.row_len[r])
+                    )
 
     # -- the flush pipeline -------------------------------------------------
 
@@ -859,6 +865,7 @@ class DocMirror:
                 i += 1
             self._note_deleted(slot, clock, ln)
 
+        plan.applied_ds.extend(applicable)
         self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
         plan.assign_levels(self._row_client)
@@ -1088,37 +1095,89 @@ class DocMirror:
         Emitted runs follow the mirror's fragmentation (never re-merged);
         the update is byte-valid and state-equivalent, like any Yjs update.
         """
+        target_sv = target_sv or {}
+        # host twin of kernels.diff_mask_kernel (the engine's batched sync
+        # path computes the same mask for many docs in one dispatch)
+        n = self.n_rows
+        needed = np.zeros(n, bool)
+        offset = np.zeros(n, np.int64)
+        for slot, st in enumerate(self.state):
+            remote = target_sv.get(self.client_of_slot[slot], 0)
+            if st <= remote:
+                continue
+            for row in self.frag_row[slot]:
+                end = self.row_clock[row] + self.row_len[row]
+                if end > remote:
+                    needed[row] = True
+                    offset[row] = max(0, remote - self.row_clock[row])
+        return self.encode_masked_update(needed, offset, v2=v2)
+
+    def encode_step_update(self, pre_sv: dict[int, int], plan: StepPlan,
+                           v2: bool = False) -> bytes | None:
+        """The incremental update one flush produced: structs beyond the
+        pre-flush state vector + the step's applied delete ranges — the
+        engine's doc.on('update') payload (reference Transaction.js:339-352
+        emits exactly the transaction's novelty)."""
+        n = self.n_rows
+        needed = np.zeros(n, bool)
+        offset = np.zeros(n, np.int64)
+        any_rows = False
+        for slot, st in enumerate(self.state):
+            known = pre_sv.get(self.client_of_slot[slot], 0)
+            if st <= known:
+                continue
+            for row in self.frag_row[slot]:
+                end = self.row_clock[row] + self.row_len[row]
+                if end > known:
+                    needed[row] = True
+                    offset[row] = max(0, known - self.row_clock[row])
+                    any_rows = True
+        if not any_rows and not plan.applied_ds:
+            return None
+        return self.encode_masked_update(
+            needed, offset, v2=v2, ds_ranges=plan.applied_ds
+        )
+
+    def encode_masked_update(self, needed, offset, v2: bool = False,
+                             ds_ranges=None) -> bytes:
+        """Wire-encode the rows selected by ``needed`` (bool [n_rows]) from
+        element ``offset`` — the writer half of sync step 2, fed either by
+        the host mask above or by the device ``diff_mask_kernel`` for the
+        engine's batched path.  ``ds_ranges`` overrides the DS section
+        (defaults to the doc's full derived DeleteSet)."""
         from ..coding import UpdateEncoderV1, UpdateEncoderV2
         from ..core import write_delete_set
         from ..lib0 import encoding as lib0enc
 
-        target_sv = target_sv or {}
         encoder = UpdateEncoderV2() if v2 else UpdateEncoderV1()
         # clients with news, descending id ("heavily improves the conflict
         # algorithm", reference encoding.js:112)
         todo = []
-        for slot, st in enumerate(self.state):
-            client = self.client_of_slot[slot]
-            clock = target_sv.get(client, 0)
-            if st > clock:
-                todo.append((client, slot, clock))
+        for slot in range(len(self.client_of_slot)):
+            rows = [r for r in self.frag_row[slot] if r < len(needed) and needed[r]]
+            if rows:
+                todo.append((self.client_of_slot[slot], rows))
         todo.sort(reverse=True)
         lib0enc.write_var_uint(encoder.rest_encoder, len(todo))
-        for client, slot, clock in todo:
-            fc, fr = self.frag_clock[slot], self.frag_row[slot]
-            i = bisect.bisect_right(fc, clock) - 1
-            if i < 0:
-                i = 0
-            lib0enc.write_var_uint(encoder.rest_encoder, len(fc) - i)
+        for client, rows in todo:
+            lib0enc.write_var_uint(encoder.rest_encoder, len(rows))
             encoder.write_client(client)
-            lib0enc.write_var_uint(encoder.rest_encoder, clock)
-            first = True
-            for j in range(i, len(fc)):
-                row = fr[j]
-                offset = clock - self.row_clock[row] if first else 0
-                first = False
-                self._write_row(encoder, row, max(0, offset))
-        write_delete_set(encoder, self.delete_set())
+            first_ofs = int(offset[rows[0]])
+            lib0enc.write_var_uint(
+                encoder.rest_encoder, self.row_clock[rows[0]] + first_ofs
+            )
+            for j, row in enumerate(rows):
+                self._write_row(encoder, row, first_ofs if j == 0 else 0)
+        if ds_ranges is None:
+            ds = self.delete_set()
+        else:
+            from ..core import DeleteItem, DeleteSet, sort_and_merge_delete_set
+
+            ds = DeleteSet()
+            for client, clock, ln in ds_ranges:
+                ds.clients.setdefault(client, []).append(DeleteItem(clock, ln))
+            sort_and_merge_delete_set(ds)
+        write_delete_set(encoder, ds)
         return encoder.to_bytes()
 
     def _write_row(self, encoder, row: int, offset: int) -> None:

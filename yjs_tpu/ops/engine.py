@@ -88,6 +88,9 @@ class BatchEngine:
         self._rows_at_compact = [0] * n_docs
         # per-doc stats of the most recent flush's compactions
         self.last_compaction: list[dict] | None = None
+        # doc.on('update') seam: callbacks (doc_idx, update_bytes) invoked
+        # after each flush with the flush's incremental update per doc
+        self._update_listeners: list = []
         self._metrics_dev: dict | None = None
         self._sharded_step = None
         if mesh is not None:
@@ -124,7 +127,22 @@ class BatchEngine:
             self._update_log[doc].append((update, v2))
             self.mirrors[doc].ingest(update, v2)
 
-    def _demote(self, doc: int) -> Doc:
+    def on_update(self, callback) -> None:
+        """Register ``callback(doc_idx, update_bytes)`` — called after each
+        flush with that flush's incremental update per changed doc (the
+        reference doc.on('update') broadcast contract,
+        Transaction.js:339-352).  Demoted docs keep emitting via their CPU
+        Doc's own update events."""
+        self._update_listeners.append(callback)
+
+    def off_update(self, callback) -> None:
+        self._update_listeners.remove(callback)
+
+    def _emit(self, doc: int, update: bytes) -> None:
+        for cb in self._update_listeners:
+            cb(doc, update)
+
+    def _demote(self, doc: int, pre_sv: dict[int, int] | None = None) -> Doc:
         """Move a doc to the CPU reference path by replaying its update log."""
         fb = Doc(gc=False)
         for update, v2 in self._update_log[doc]:
@@ -132,6 +150,22 @@ class BatchEngine:
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
         self._update_log[doc] = []
+        if self._update_listeners:
+            # emit the demoting flush's novelty, then live-forward the
+            # fallback doc's own update events
+            from ..updates import encode_state_as_update, encode_state_vector
+            from ..coding import DSEncoderV1
+            from ..updates import write_state_vector
+
+            enc_sv = None
+            if pre_sv:
+                e = DSEncoderV1()
+                write_state_vector(e, pre_sv)
+                enc_sv = e.to_bytes()
+            novelty = encode_state_as_update(fb, enc_sv)
+            if novelty:
+                self._emit(doc, novelty)
+        fb.on("update", lambda u, origin, d, i=doc: self._emit(i, u))
         return fb
 
     # -- device state management -------------------------------------------
@@ -210,13 +244,17 @@ class BatchEngine:
     def flush(self) -> None:
         self._maybe_compact()
         plans = {}
+        pre_svs: dict[int, dict[int, int]] = {}
+        emitting = bool(self._update_listeners)
         for i, m in enumerate(self.mirrors):
             if i in self.fallback:
                 continue
+            if emitting:
+                pre_svs[i] = m.state_vector()
             try:
                 plans[i] = m.prepare_step()
             except UnsupportedUpdate:
-                self._demote(i)
+                self._demote(i, pre_svs.get(i))
         if not plans:
             return
         n_splits = _bucket(max((len(p.splits) for p in plans.values()), default=0), 1)
@@ -298,6 +336,14 @@ class BatchEngine:
             m = self.mirrors[i]
             if len(self._update_log[i]) > 64 and not m.has_pending():
                 self._update_log[i] = [(m.encode_state_as_update(), False)]
+
+        # doc.on('update') seam: emit each doc's flush novelty (host-side
+        # data only — overlaps the async device dispatch)
+        if emitting:
+            for i, p in plans.items():
+                u = self.mirrors[i].encode_step_update(pre_svs[i], p)
+                if u is not None:
+                    self._emit(i, u)
 
     @property
     def last_metrics(self) -> dict | None:
@@ -409,6 +455,105 @@ class BatchEngine:
 
             target = decode_state_vector(encoded_target_sv)
         return self.mirrors[doc].encode_state_as_update(target, v2=v2)
+
+    # -- batched sync kernels ----------------------------------------------
+
+    def _sync_columns(self, docs: list[int]):
+        """Stacked (row_slot, row_clock, row_end) columns for a doc subset,
+        padded to the widest doc (NULL rows are masked by the kernels)."""
+        n = max((self.mirrors[i].n_rows for i in docs), default=0)
+        n = max(n, 1)
+        k = len(docs)
+        row_slot = np.full((k, n), NULL, np.int32)
+        row_clock = np.zeros((k, n), np.int32)
+        row_end = np.zeros((k, n), np.int32)
+        for j, i in enumerate(docs):
+            m = self.mirrors[i]
+            r = m.n_rows
+            if r:
+                row_slot[j, :r] = m.row_slot
+                row_clock[j, :r] = m.row_clock
+                row_end[j, :r] = (
+                    np.asarray(m.row_clock, np.int64)
+                    + np.asarray(m.row_len, np.int64)
+                ).astype(np.int32)
+        return row_slot, row_clock, row_end
+
+    def state_vectors_batched(self, docs: list[int]) -> list[dict[int, int]]:
+        """State vectors for many docs in ONE ``state_vector_kernel``
+        dispatch (the segment-max of StructStore.getStateVector batched
+        over the doc axis — SURVEY.md §2 sync-protocol row).  Results align
+        positionally with ``docs``; fallback docs are served by the CPU
+        core."""
+        out: list[dict[int, int] | None] = [None] * len(docs)
+        dev = [(j, i) for j, i in enumerate(docs) if i not in self.fallback]
+        for j, i in enumerate(docs):
+            if i in self.fallback:
+                out[j] = self.state_vector(i)
+        if dev:
+            dev_docs = [i for _, i in dev]
+            row_slot, _clock, row_end = self._sync_columns(dev_docs)
+            n_slots = max(len(self.mirrors[i].client_of_slot) for i in dev_docs)
+            sv = np.asarray(
+                kernels.state_vector_kernel(
+                    jnp.asarray(row_slot), jnp.asarray(row_end), max(1, n_slots)
+                )
+            )
+            for r, (j, i) in enumerate(dev):
+                m = self.mirrors[i]
+                out[j] = {
+                    m.client_of_slot[s]: int(sv[r, s])
+                    for s in range(len(m.client_of_slot))
+                    if sv[r, s] > 0
+                }
+        return out
+
+    def sync_step2_batch(
+        self, requests: list[tuple[int, dict[int, int] | None]], v2: bool = False
+    ) -> list[bytes]:
+        """Answer many sync-step-1 requests with ONE ``diff_mask_kernel``
+        dispatch: (doc, remote state vector) pairs in, diff updates out
+        (reference encodeStateAsUpdate, encoding.js:490-526, batched over
+        the doc axis).  Fallback docs are served by the CPU core."""
+        replies: list[bytes | None] = [None] * len(requests)
+        dev = [
+            (j, i, sv) for j, (i, sv) in enumerate(requests) if i not in self.fallback
+        ]
+        for j, (i, sv) in enumerate(requests):
+            if i in self.fallback:
+                enc_sv = None
+                if sv:
+                    from ..coding import DSEncoderV1
+                    from ..updates import write_state_vector
+
+                    e = DSEncoderV1()
+                    write_state_vector(e, sv)
+                    enc_sv = e.to_bytes()
+                replies[j] = self.encode_state_as_update(i, enc_sv, v2=v2)
+        if dev:
+            docs = [i for _, i, _ in dev]
+            row_slot, row_clock, row_end = self._sync_columns(docs)
+            n_slots = max(1, max(len(self.mirrors[i].client_of_slot) for i in docs))
+            sv_dense = np.zeros((len(dev), n_slots), np.int32)
+            for r, (_j, i, sv) in enumerate(dev):
+                m = self.mirrors[i]
+                for client, clock in (sv or {}).items():
+                    s = m.slot_of_client.get(client)
+                    if s is not None:
+                        sv_dense[r, s] = clock
+            needed, offset = kernels.diff_mask_kernel(
+                jnp.asarray(row_slot),
+                jnp.asarray(row_clock),
+                jnp.asarray(row_end),
+                jnp.asarray(sv_dense),
+            )
+            needed = np.asarray(needed)
+            offset = np.asarray(offset)
+            for r, (j, i, _sv) in enumerate(dev):
+                replies[j] = self.mirrors[i].encode_masked_update(
+                    needed[r], offset[r], v2=v2
+                )
+        return replies
 
     def has_pending(self, doc: int) -> bool:
         if doc in self.fallback:

@@ -28,6 +28,7 @@ class TpuProvider:
     ):
         self.engine = BatchEngine(n_docs, root_name=root_name, mesh=mesh, gc=gc)
         self._guids: dict[str, int] = {}
+        self._guid_of: dict[int, str] = {}
         self._next = 0
         self._dirty = False
 
@@ -42,7 +43,16 @@ class TpuProvider:
             i = self._next
             self._next += 1
             self._guids[guid] = i
+            self._guid_of[i] = guid
         return i
+
+    def on_update(self, callback) -> None:
+        """Register ``callback(guid, update_bytes)``: the flush-emitted
+        incremental update per room — the server's broadcast-to-peers seam
+        (a transport pushes these as MESSAGE_YJS_UPDATE frames)."""
+        self.engine.on_update(
+            lambda doc, update: callback(self._guid_of[doc], update)
+        )
 
     # -- update plumbing ----------------------------------------------------
 
@@ -88,6 +98,33 @@ class TpuProvider:
             self._dirty = True
             return None
         raise ValueError(f"unknown sync message type {msg_type}")
+
+    def handle_sync_step1_batch(
+        self, messages: list[tuple[str, bytes]]
+    ) -> list[bytes]:
+        """Answer many concurrent sync-step-1 messages with ONE device
+        dispatch (the server's fan-in moment: N clients reconnect, N diffs
+        computed by one ``diff_mask_kernel`` call).  Returns the framed
+        step-2 reply per message."""
+        from .updates import decode_state_vector
+
+        self.flush()
+        requests = []
+        for guid, message in messages:
+            dec = Decoder(message)
+            msg_type = decoding.read_var_uint(dec)
+            if msg_type != protocol.MESSAGE_YJS_SYNC_STEP_1:
+                raise ValueError("batch handler only accepts sync step 1")
+            remote_sv = decode_state_vector(decoding.read_var_uint8_array(dec))
+            requests.append((self.doc_id(guid), remote_sv))
+        updates = self.engine.sync_step2_batch(requests)
+        replies = []
+        for u in updates:
+            enc = Encoder()
+            encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_2)
+            encoding.write_var_uint8_array(enc, u)
+            replies.append(enc.to_bytes())
+        return replies
 
     # -- state accessors ----------------------------------------------------
 
