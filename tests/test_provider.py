@@ -61,6 +61,30 @@ class TestProvider:
         prov.flush()
         assert prov.n_fallback_docs == 1
         assert prov.text("mixed") == "t"
+        # the demotion is visible with its reason, not silent
+        assert prov.demotions == [
+            {"guid": "mixed", "reason": "content ref 7"}
+        ]
+        assert prov.metrics["n_demoted"] == 1
+
+    def test_flush_metrics_phases_and_occupancy(self):
+        prov = TpuProvider(4)
+        for room in ("r0", "r1"):
+            d = Y.Doc(gc=False)
+            d.client_id = 7
+            d.get_text("text").insert(0, "hello")
+            prov.receive_update(room, Y.encode_state_as_update(d))
+        prov.flush()
+        m = prov.metrics
+        assert m["n_docs_flushed"] == 2
+        assert m["n_demoted"] == 0 and m["n_fallback_docs"] == 0
+        assert m["n_sched_entries"] >= 2
+        assert 0.0 < m["schedule_occupancy"] <= 1.0
+        assert m["n_pending_docs"] == 0 and m["pending_depth"] == 0
+        for k in ("t_compact_s", "t_plan_s", "t_pack_s", "t_dispatch_s",
+                  "t_emit_s", "t_total_s"):
+            assert m[k] >= 0.0
+        assert m["t_total_s"] >= m["t_plan_s"]
 
     def test_map_room_served_on_device(self):
         prov = TpuProvider(2)

@@ -758,11 +758,14 @@ class DocMirror:
             ks = cuts.get(client)
             if not ks:
                 continue
+            ks_sorted = sorted(ks)
             for i in idxs:
                 ref = sched[i]
                 if ref.is_gc:
                     continue
-                inner = sorted(k for k in ks if ref.clock < k < ref.clock + ref.length)
+                lo = bisect.bisect_right(ks_sorted, ref.clock)
+                hi = bisect.bisect_left(ks_sorted, ref.clock + ref.length, lo)
+                inner = ks_sorted[lo:hi]
                 if not inner:
                     continue
                 parts = [ref]

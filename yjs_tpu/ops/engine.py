@@ -12,7 +12,9 @@ back to the CPU reference core — the Provider gating seam.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import time
 
 import numpy as np
 
@@ -57,6 +59,15 @@ def _bucket(n: int, minimum: int = 64) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _phase(name: str):
+    """jax.profiler annotation around one flush phase when profiling is on
+    (YTPU_PROFILE_DIR or an active jax.profiler trace) — the per-phase
+    tracing SURVEY.md §5 calls for; a no-op otherwise."""
+    if not HAS_JAX:
+        return contextlib.nullcontext()
+    return jax.profiler.TraceAnnotation(f"ytpu.{name}")
 
 
 class BatchEngine:
@@ -107,6 +118,11 @@ class BatchEngine:
         self.mirrors: list[DocMirror] = [DocMirror(root_name) for _ in range(n_docs)]
         # CPU fallback docs (Provider gating): doc idx -> Doc
         self.fallback: dict[int, Doc] = {}
+        # every demotion ever, with its reason — scope gaps are measurable,
+        # not silent (each entry: {"doc", "reason"})
+        self.demotions: list[dict] = []
+        # host-side per-phase timers + batch stats of the last flush
+        self.last_flush_metrics: dict | None = None
         self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
         # persistent device state (no left-link array: order is ranked from
         # right links with a host-known membership mask)
@@ -142,8 +158,14 @@ class BatchEngine:
         for cb in self._update_listeners:
             cb(doc, update)
 
-    def _demote(self, doc: int, pre_sv: dict[int, int] | None = None) -> Doc:
+    def _demote(
+        self,
+        doc: int,
+        pre_sv: dict[int, int] | None = None,
+        reason: str = "unspecified",
+    ) -> Doc:
         """Move a doc to the CPU reference path by replaying its update log."""
+        self.demotions.append({"doc": doc, "reason": reason})
         fb = Doc(gc=False)
         for update, v2 in self._update_log[doc]:
             (apply_update_v2 if v2 else apply_update)(fb, update)
@@ -242,108 +264,177 @@ class BatchEngine:
     # -- flush: run one device integration step ----------------------------
 
     def flush(self) -> None:
-        self._maybe_compact()
+        t_start = time.perf_counter()
+        with _phase("compact"):
+            self._maybe_compact()
+        t_compact = time.perf_counter()
         plans = {}
         pre_svs: dict[int, dict[int, int]] = {}
+        demoted_now = 0
         emitting = bool(self._update_listeners)
-        for i, m in enumerate(self.mirrors):
-            if i in self.fallback:
-                continue
-            if emitting:
-                pre_svs[i] = m.state_vector()
-            try:
-                plans[i] = m.prepare_step()
-            except UnsupportedUpdate:
-                self._demote(i, pre_svs.get(i))
+        with _phase("plan"):
+            for i, m in enumerate(self.mirrors):
+                if i in self.fallback:
+                    continue
+                if emitting:
+                    pre_svs[i] = m.state_vector()
+                try:
+                    plans[i] = m.prepare_step()
+                except UnsupportedUpdate as e:
+                    self._demote(i, pre_svs.get(i), reason=str(e))
+                    demoted_now += 1
+        t_plan = time.perf_counter()
         if not plans:
+            self.last_flush_metrics = {
+                "n_docs_flushed": 0,
+                "n_demoted": demoted_now,
+                "n_fallback_docs": len(self.fallback),
+                "n_rows_max": 0,
+                "n_sched_entries": 0,
+                "n_levels": 0,
+                "level_width": 0,
+                "schedule_occupancy": 0.0,
+                "n_pending_docs": 0,
+                "pending_depth": 0,
+                "t_compact_s": t_compact - t_start,
+                "t_plan_s": t_plan - t_compact,
+                "t_pack_s": 0.0,
+                "t_dispatch_s": 0.0,
+                "t_emit_s": 0.0,
+                "t_total_s": time.perf_counter() - t_start,
+            }
             return
-        n_splits = _bucket(max((len(p.splits) for p in plans.values()), default=0), 1)
-        n_sched = _bucket(max((len(p.sched) for p in plans.values()), default=0), 1)
-        n_del = _bucket(max((len(p.delete_rows) for p in plans.values()), default=0), 1)
-        packed = {i: p.packed_levels() for i, p in plans.items()}
-        n_lv = _bucket(max((len(pk) for pk in packed.values()), default=0), 1)
-        w_lv = _bucket(
-            max((len(lv) for pk in packed.values() for lv in pk), default=0), 1
-        )
-        max_rows = max((p.n_rows for p in plans.values()), default=0)
-        max_segs = max(
-            (self.mirrors[i].n_segs for i in plans), default=0
-        )
-        # reserve >= 2*w_lv spare row slots per doc: the level kernel's
-        # merged scatter uses two unique scratch lanes per schedule slot
-        self._ensure_capacity(max_rows + 2 * w_lv, max_segs)
-        b, cap = self.n_docs, self._cap
-
-        splits = np.full((b, n_splits, 2), NULL, np.int32)
-        sched = np.full((b, n_sched, 4), NULL, np.int32)
-        lv_sched = np.full((b, n_lv, w_lv, 6), NULL, np.int32)
-        dels = np.full((b, n_del), NULL, np.int32)
-        statics = {
-            "client_key": np.zeros((b, cap + 1), np.uint32),
-            "origin_slot": np.full((b, cap + 1), NULL, np.int32),
-            "origin_clock": np.zeros((b, cap + 1), np.int32),
-            "right_slot": np.full((b, cap + 1), NULL, np.int32),
-            "right_clock": np.zeros((b, cap + 1), np.int32),
-            "origin_row": np.full((b, cap + 1), NULL, np.int32),
-        }
-        for i, p in plans.items():
-            m = self.mirrors[i]
-            n = m.n_rows
-            if n:
-                cols = m.static_columns()
-                for k in statics:
-                    statics[k][i, :n] = cols[k]
-            if p.splits:
-                splits[i, : len(p.splits)] = p.splits
-            if p.sched:
-                sched[i, : len(p.sched)] = p.sched
-            for lv, entries in enumerate(packed[i]):
-                if entries:
-                    lv_sched[i, lv, : len(entries)] = entries
-            if p.delete_rows:
-                dels[i, : len(p.delete_rows)] = p.delete_rows
-
-        scratch_base = np.zeros((b,), np.int32)
-        for i, p in plans.items():
-            scratch_base[i] = p.n_rows
-
-        statics = {k: jnp.asarray(v) for k, v in statics.items()}
-        dyn = (self._right, self._deleted, self._starts)
-        if self._sharded_step is not None:
-            # keep metrics as device scalars: converting here would block the
-            # async dispatch and serialize host transcode with device compute
-            new_dyn, self._metrics_dev = self._sharded_step(
-                statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
-                jnp.asarray(dels), jnp.asarray(scratch_base),
+        with _phase("pack"):
+            n_splits = _bucket(
+                max((len(p.splits) for p in plans.values()), default=0), 1
             )
-        elif os.environ.get("YTPU_KERNEL") == "seq":
-            new_dyn = kernels.batch_step(
-                statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
-                jnp.asarray(dels),
+            n_sched = _bucket(
+                max((len(p.sched) for p in plans.values()), default=0), 1
             )
-        else:
-            new_dyn = kernels.batch_step_levels(
-                statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
-                jnp.asarray(dels), jnp.asarray(scratch_base),
+            n_del = _bucket(
+                max((len(p.delete_rows) for p in plans.values()), default=0), 1
             )
-        self._right, self._deleted, self._starts = new_dyn
+            packed = {i: p.packed_levels() for i, p in plans.items()}
+            n_lv = _bucket(max((len(pk) for pk in packed.values()), default=0), 1)
+            w_lv = _bucket(
+                max((len(lv) for pk in packed.values() for lv in pk), default=0), 1
+            )
+            max_rows = max((p.n_rows for p in plans.values()), default=0)
+            max_segs = max(
+                (self.mirrors[i].n_segs for i in plans), default=0
+            )
+            # reserve >= 2*w_lv spare row slots per doc: the level kernel's
+            # merged scatter uses two unique scratch lanes per schedule slot
+            self._ensure_capacity(max_rows + 2 * w_lv, max_segs)
+            b, cap = self.n_docs, self._cap
 
-        # compact long demotion-replay logs: once a doc's integrated state is
-        # pending-free, its own columnar export supersedes the raw update
-        # prefix.  After the dispatch so the O(doc) host encode overlaps
-        # device execution; amortized by the length threshold
-        for i in plans:
-            m = self.mirrors[i]
-            if len(self._update_log[i]) > 64 and not m.has_pending():
-                self._update_log[i] = [(m.encode_state_as_update(), False)]
-
-        # doc.on('update') seam: emit each doc's flush novelty (host-side
-        # data only — overlaps the async device dispatch)
-        if emitting:
+            splits = np.full((b, n_splits, 2), NULL, np.int32)
+            sched = np.full((b, n_sched, 4), NULL, np.int32)
+            lv_sched = np.full((b, n_lv, w_lv, 6), NULL, np.int32)
+            dels = np.full((b, n_del), NULL, np.int32)
+            statics = {
+                "client_key": np.zeros((b, cap + 1), np.uint32),
+                "origin_slot": np.full((b, cap + 1), NULL, np.int32),
+                "origin_clock": np.zeros((b, cap + 1), np.int32),
+                "right_slot": np.full((b, cap + 1), NULL, np.int32),
+                "right_clock": np.zeros((b, cap + 1), np.int32),
+                "origin_row": np.full((b, cap + 1), NULL, np.int32),
+            }
             for i, p in plans.items():
-                u = self.mirrors[i].encode_step_update(pre_svs[i], p)
-                if u is not None:
-                    self._emit(i, u)
+                m = self.mirrors[i]
+                n = m.n_rows
+                if n:
+                    cols = m.static_columns()
+                    for k in statics:
+                        statics[k][i, :n] = cols[k]
+                if p.splits:
+                    splits[i, : len(p.splits)] = p.splits
+                if p.sched:
+                    sched[i, : len(p.sched)] = p.sched
+                for lv, entries in enumerate(packed[i]):
+                    if entries:
+                        lv_sched[i, lv, : len(entries)] = entries
+                if p.delete_rows:
+                    dels[i, : len(p.delete_rows)] = p.delete_rows
+
+            scratch_base = np.zeros((b,), np.int32)
+            for i, p in plans.items():
+                scratch_base[i] = p.n_rows
+
+            statics = {k: jnp.asarray(v) for k, v in statics.items()}
+        t_pack = time.perf_counter()
+        with _phase("dispatch"):
+            dyn = (self._right, self._deleted, self._starts)
+            if self._sharded_step is not None:
+                # keep metrics as device scalars: converting here would block
+                # the async dispatch and serialize host transcode with device
+                # compute
+                new_dyn, self._metrics_dev = self._sharded_step(
+                    statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
+                    jnp.asarray(dels), jnp.asarray(scratch_base),
+                )
+            elif os.environ.get("YTPU_KERNEL") == "seq":
+                new_dyn = kernels.batch_step(
+                    statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
+                    jnp.asarray(dels),
+                )
+            else:
+                new_dyn = kernels.batch_step_levels(
+                    statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
+                    jnp.asarray(dels), jnp.asarray(scratch_base),
+                )
+            self._right, self._deleted, self._starts = new_dyn
+        t_dispatch = time.perf_counter()
+
+        with _phase("emit"):
+            # compact long demotion-replay logs: once a doc's integrated
+            # state is pending-free, its own columnar export supersedes the
+            # raw update prefix.  After the dispatch so the O(doc) host
+            # encode overlaps device execution; amortized by the threshold
+            for i in plans:
+                m = self.mirrors[i]
+                if len(self._update_log[i]) > 64 and not m.has_pending():
+                    self._update_log[i] = [(m.encode_state_as_update(), False)]
+
+            # doc.on('update') seam: emit each doc's flush novelty
+            # (host-side data only — overlaps the async device dispatch)
+            if emitting:
+                for i, p in plans.items():
+                    u = self.mirrors[i].encode_step_update(pre_svs[i], p)
+                    if u is not None:
+                        self._emit(i, u)
+        t_emit = time.perf_counter()
+
+        n_sched_entries = sum(len(p.sched6) for p in plans.values())
+        lv_slots = b * n_lv * w_lv
+        pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
+        self.last_flush_metrics = {
+            "n_docs_flushed": sum(
+                1
+                for p in plans.values()
+                if p.sched6 or p.splits or p.delete_rows
+            ),
+            "n_demoted": demoted_now,
+            "n_fallback_docs": len(self.fallback),
+            "n_rows_max": max_rows,
+            "n_sched_entries": n_sched_entries,
+            "n_levels": n_lv,
+            "level_width": w_lv,
+            # fraction of the padded [B, L, W] schedule that is real work
+            "schedule_occupancy": n_sched_entries / lv_slots if lv_slots else 0.0,
+            "n_pending_docs": len(pending_docs),
+            "pending_depth": sum(
+                sum(len(q) for q in self.mirrors[i].pending.values())
+                + len(self.mirrors[i].pending_ds)
+                for i in pending_docs
+            ),
+            "t_compact_s": t_compact - t_start,
+            "t_plan_s": t_plan - t_compact,
+            "t_pack_s": t_pack - t_plan,
+            "t_dispatch_s": t_dispatch - t_pack,
+            "t_emit_s": t_emit - t_dispatch,
+            "t_total_s": t_emit - t_start,
+        }
 
     @property
     def last_metrics(self) -> dict | None:

@@ -143,3 +143,17 @@ class TpuProvider:
     @property
     def n_fallback_docs(self) -> int:
         return len(self.engine.fallback)
+
+    @property
+    def demotions(self) -> list[dict]:
+        """Every device→CPU demotion with its reason, keyed by room guid —
+        scope gaps are measurable, not silent."""
+        return [
+            {"guid": self._guid_of[d["doc"]], "reason": d["reason"]}
+            for d in self.engine.demotions
+        ]
+
+    @property
+    def metrics(self) -> dict | None:
+        """Host per-phase timers + batch stats of the last flush."""
+        return self.engine.last_flush_metrics
