@@ -1,33 +1,51 @@
 """Benchmark: batched device applyUpdate vs the single-threaded CPU core.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Workload: a synthetic B4-style two-client editing trace (interleaved typing
-bursts, deletes, periodic sync — modelled on the real-world trace statistics
-cited in reference INTERNALS.md:128-130), replayed independently by B docs.
-The host transcodes the merged update once and broadcasts the plan across the
-batch (every doc receives the same bytes, as in the BASELINE.json "100k-doc
-B4-trace replay" config); the device integrates all B docs in one vmapped
-kernel call.
+Three variants, all reported in "detail" (VERDICT r1 item 2: end-to-end
+timing including host transcode, distinct-vs-broadcast, B4 scale):
 
-value = device-integrated CRDT elements/second (elements = characters +
-tombstoned chars, identical work for both paths).  vs_baseline = that rate
-over the single-threaded CPU reference core's applyUpdate rate on the same
-update (the in-repo stand-in for the reference's single-threaded JS path:
-Node.js is not available in this image).
+1. **b4_broadcast** (the headline): every doc replays the same B4-scale
+   editing trace (tests/fixtures/b4_trace.bin — 182k single-char inserts /
+   77k deletes with the real B4's sequential-typing texture, synthesized by
+   scripts/gen_b4_fixture.py because the real crdt-benchmarks dataset is
+   not retrievable here; statistics per reference INTERNALS.md:128-130).
+   This is BASELINE.json's "100k-doc Y.Text B4-trace replay" shape: the
+   trace is transcoded ONCE on the host and the plan broadcast across the
+   batch.  End-to-end time INCLUDES host transcode + padding/pack + the
+   host->device transfer + device integration + a readback barrier.
+2. **distinct**: every doc receives a *different* trace through the full
+   product path (BatchEngine.flush: per-doc decode, causal schedule,
+   pre-split, pack, dispatch).  No broadcast amortization — this is the
+   honest per-doc host cost, and it is host-bound (see detail timers).
+3. **sync**: batched sync-step-2 (encodeStateAsUpdate against a remote
+   state vector) across all distinct docs in one diff_mask_kernel dispatch.
 
-Env knobs: YTPU_BENCH_DOCS (default 4096), YTPU_BENCH_OPS (default 1500).
+Baseline: the repo's own single-threaded CPU reference core measures
+`cpu_py_*` on the same traces.  Node.js is NOT available in this image, so
+the north-star "single-threaded Node applyUpdate rate" is estimated as
+cpu_py_rate x NODE_PROXY_FACTOR (default 20; see BASELINE.md "Node proxy"
+for the calibration argument and sensitivity).  vs_baseline is measured
+against that PROXY, not against Python.
+
+Env knobs: YTPU_BENCH_DOCS (b4 broadcast batch, default 16384),
+YTPU_BENCH_DISTINCT_DOCS (default 64), YTPU_BENCH_OPS (distinct trace ops,
+default 1500), YTPU_NODE_PROXY_FACTOR (default 20).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import random
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
+
+NODE_PROXY_FACTOR = float(os.environ.get("YTPU_NODE_PROXY_FACTOR", "20"))
 
 
 def gen_trace(n_ops: int, seed: int = 7):
@@ -73,41 +91,63 @@ def gen_trace(n_ops: int, seed: int = 7):
     return Y.encode_state_as_update(a), a
 
 
-def main():
-    import jax
+def cpu_apply_rate(update: bytes, repeats: int = 1) -> tuple[float, int]:
+    """Single-threaded CPU reference-core applyUpdate rate on one update
+    (median of ``repeats`` runs — interpreter variance is real).  Returns
+    (elements/sec, n_elements) where elements = integrated clocks."""
+    import yjs_tpu as Y
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        doc = Y.Doc(gc=False)
+        Y.apply_update(doc, update)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    sv = Y.decode_state_vector(Y.encode_state_vector(doc))
+    n_elements = sum(sv.values())
+    return (n_elements / dt if dt > 0 else 0.0), n_elements
+
+
+# ---------------------------------------------------------------------------
+# Variant 1: B4-scale broadcast replay (transcode once, integrate B docs)
+# ---------------------------------------------------------------------------
+
+
+def bench_b4_broadcast(n_docs: int) -> dict:
     import jax.numpy as jnp
 
-    import yjs_tpu as Y
     from yjs_tpu.ops import kernels
     from yjs_tpu.ops.columns import NULL, DocMirror
+    from yjs_tpu.ops.engine import visible_text
 
-    n_docs = int(os.environ.get("YTPU_BENCH_DOCS", "4096"))
-    n_ops = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
+    fixtures = Path(__file__).resolve().parent / "tests" / "fixtures"
+    b4_path = fixtures / "b4_trace.bin"
+    if b4_path.exists():
+        update = b4_path.read_bytes()
+        meta = json.loads((fixtures / "b4_trace.json").read_text())
+        trace_name = "b4_fixture"
+    else:  # standalone fallback: synthesize a smaller trace on the fly
+        update, ref_doc = gen_trace(int(os.environ.get("YTPU_BENCH_OPS", "1500")))
+        text = ref_doc.get_text("text").to_string()
+        meta = {
+            "text_len": len(text),
+            "text_sha256": hashlib.sha256(text.encode()).hexdigest(),
+        }
+        trace_name = "synthetic_small (b4 fixture missing)"
 
-    update, ref_doc = gen_trace(n_ops)
+    cpu_rate, n_elements = cpu_apply_rate(update, repeats=3)
 
-    # ---- CPU baseline: single-threaded reference-core applyUpdate ----------
+    # ---- host transcode (ONCE — the broadcast amortization) --------------
     t0 = time.perf_counter()
-    cpu_doc = Y.Doc(gc=False)
-    Y.apply_update(cpu_doc, update)
-    cpu_time = time.perf_counter() - t0
-    sv = Y.decode_state_vector(Y.encode_state_vector(cpu_doc))
-    n_elements = sum(sv.values())
-    if n_elements == 0:
-        print(json.dumps({"metric": "batched_apply_update_elements_per_sec",
-                          "value": 0, "unit": "elem/s (empty workload)",
-                          "vs_baseline": 0}))
-        return
-    cpu_rate = n_elements / cpu_time
-
-    # ---- host transcode (once) + broadcast across the doc batch ------------
     mirror = DocMirror("text")
     mirror.ingest(update, v2=False)
-    t0 = time.perf_counter()
     plan = mirror.prepare_step()
-    transcode_time = time.perf_counter() - t0
+    t_transcode = time.perf_counter() - t0
+
+    # ---- pack + pad + host->device transfer ------------------------------
+    t0 = time.perf_counter()
     n = mirror.n_rows
-    # the level kernel scatters masked lanes into >= 2W spare slots past n
     packed = plan.packed_levels()
     w_pad = max((len(lv) for lv in packed), default=1)
     cap = max(64, n + 2 * w_pad)
@@ -116,38 +156,33 @@ def main():
     def pad_col(key, fill, dtype):
         arr = np.full((cap + 1,), fill, dtype)
         arr[:n] = cols[key]
-        return np.broadcast_to(arr, (n_docs, cap + 1))
+        return arr
 
-    statics = {
-        "client_key": pad_col("client_key", 0, np.uint32),
-        "origin_slot": pad_col("origin_slot", NULL, np.int32),
-        "origin_clock": pad_col("origin_clock", 0, np.int32),
-        "right_slot": pad_col("right_slot", NULL, np.int32),
-        "right_clock": pad_col("right_clock", 0, np.int32),
-        "origin_row": pad_col("origin_row", NULL, np.int32),
+    # ONE copy of each column crosses the host->device link; the shared
+    # kernel (vmap in_axes=None) broadcasts it across the batch inside XLA
+    statics_d = {
+        "client_key": jnp.asarray(pad_col("client_key", 0, np.uint32)),
+        "origin_slot": jnp.asarray(pad_col("origin_slot", NULL, np.int32)),
+        "origin_clock": jnp.asarray(pad_col("origin_clock", 0, np.int32)),
+        "right_slot": jnp.asarray(pad_col("right_slot", NULL, np.int32)),
+        "right_clock": jnp.asarray(pad_col("right_clock", 0, np.int32)),
+        "origin_row": jnp.asarray(pad_col("origin_row", NULL, np.int32)),
     }
-    sched = np.full((n_docs, 1, 4), NULL, np.int32)
-    lv_sched = np.full((n_docs, 1, 1, 6), NULL, np.int32)
+    lv_one = np.full((1, 1, 6), NULL, np.int32)
     if plan.sched:
-        sched = np.broadcast_to(
-            np.asarray(plan.sched, np.int32), (n_docs, len(plan.sched), 4)
-        )
-        one = np.full((len(packed), w_pad, 6), NULL, np.int32)
+        lv_one = np.full((len(packed), w_pad, 6), NULL, np.int32)
         for lv, entries in enumerate(packed):
             if entries:
-                one[lv, : len(entries)] = entries
-        lv_sched = np.broadcast_to(one, (n_docs,) + one.shape)
-    splits = np.full((n_docs, 1, 2), NULL, np.int32)
+                lv_one[lv, : len(entries)] = entries
+    lv_d = jnp.asarray(lv_one)
+    splits_one = np.full((1, 2), NULL, np.int32)
     if plan.splits:
-        splits = np.broadcast_to(
-            np.asarray(plan.splits, np.int32), (n_docs, len(plan.splits), 2)
-        )
-    dels = np.full((n_docs, 1), NULL, np.int32)
+        splits_one = np.asarray(plan.splits, np.int32)
+    splits_d = jnp.asarray(splits_one)
+    dels_one = np.full((1,), NULL, np.int32)
     if plan.delete_rows:
-        dels = np.broadcast_to(
-            np.asarray(plan.delete_rows, np.int32), (n_docs, len(plan.delete_rows))
-        )
-
+        dels_one = np.asarray(plan.delete_rows, np.int32)
+    dels_d = jnp.asarray(dels_one)
     seg_cap = max(8, mirror.n_segs)
 
     def fresh_dyn():
@@ -157,36 +192,33 @@ def main():
             jnp.full((n_docs, seg_cap + 1), NULL, jnp.int32),
         )
 
-    statics_d = {k: jnp.asarray(v) for k, v in statics.items()}
-    splits_d, sched_d, dels_d = jnp.asarray(splits), jnp.asarray(sched), jnp.asarray(dels)
-    lv_d = jnp.asarray(lv_sched)
     scratch_base = jnp.full((n_docs,), n, jnp.int32)
+    # readback barrier on EVERY transfer (block_until_ready does not
+    # synchronize on the axon tunnel backend): none may escape the timed
+    # window into the untimed warmup.  Whole-buffer readback avoids
+    # compiling a slice program per array; ~1MB total.
+    for arr in (*statics_d.values(), lv_d, splits_d, dels_d, scratch_base):
+        np.asarray(arr)
+    t_pack = time.perf_counter() - t0
 
-    if os.environ.get("YTPU_KERNEL") == "seq":
-        step = lambda dyn: kernels.batch_step(statics_d, dyn, splits_d, sched_d, dels_d)
-    else:
-        step = lambda dyn: kernels.batch_step_levels(
-            statics_d, dyn, splits_d, lv_d, dels_d, scratch_base
-        )
+    step = lambda dyn: kernels.batch_step_levels_shared(
+        statics_d, dyn, splits_d, lv_d, dels_d, scratch_base
+    )
 
-    # warmup/compile (block_until_ready does not synchronize on the axon
-    # tunnel backend — force completion with a device->host readback)
+    # warmup/compile excluded (cached for all later runs; block via readback
+    # because block_until_ready does not synchronize on the axon tunnel)
     out = step(fresh_dyn())
     np.asarray(out[2])
 
-    # timed: K chained dispatches, one readback (amortizes the ~90ms tunnel
-    # round-trip out of the per-step figure)
-    K = 8
+    # device-only: K chained dispatches, one readback barrier
+    K = 4
     t0 = time.perf_counter()
     for _ in range(K):
         out = step(fresh_dyn())
-    np.asarray(out[0][:, 0])  # readback forces full completion
-    device_time = (time.perf_counter() - t0) / K
-    device_rate = n_docs * n_elements / device_time
+    np.asarray(out[0][:, 0])
+    t_device = (time.perf_counter() - t0) / K
 
-    # correctness spot-check: doc 0's visible text vs the CPU core
-    from yjs_tpu.ops.engine import visible_text
-
+    # ---- convergence check: doc 0's visible text vs the reference --------
     right, deleted, start = out
     text_seg = mirror.segments[("text", None)]
     valid = np.zeros(cap + 1, bool)
@@ -196,18 +228,151 @@ def main():
     rows = np.nonzero(d >= 0)[0]
     rows = rows[np.argsort(-d[rows], kind="stable")]
     text = visible_text(mirror, rows, dels_out[rows])
-    expect = cpu_doc.get_text("text").to_string()
-    if text != expect:
-        print(json.dumps({"metric": "FAILED_convergence_check", "value": 0,
+    if (
+        len(text) != meta["text_len"]
+        or hashlib.sha256(text.encode()).hexdigest() != meta["text_sha256"]
+    ):
+        print(json.dumps({"metric": "FAILED_b4_convergence", "value": 0,
                           "unit": "", "vs_baseline": 0}))
         sys.exit(1)
 
+    t_e2e = t_transcode + t_pack + t_device
+    total_elems = n_docs * n_elements
+    return {
+        "trace": trace_name,
+        "n_docs": n_docs,
+        "elems_per_doc": n_elements,
+        "n_rows": n,
+        "n_levels": len(packed),
+        "t_transcode_s": round(t_transcode, 4),
+        "t_pack_s": round(t_pack, 4),
+        "t_device_s": round(t_device, 4),
+        "e2e_elems_per_sec": round(total_elems / t_e2e, 1),
+        "device_elems_per_sec": round(total_elems / t_device, 1),
+        "cpu_py_elems_per_sec": round(cpu_rate, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Variant 2: distinct traffic through the full product path (BatchEngine)
+# ---------------------------------------------------------------------------
+
+
+def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
+    from yjs_tpu.ops import BatchEngine
+
+    # workload synthesis (per-doc distinct traces) — NOT timed: this stands
+    # in for network receive, not for framework work
+    updates, cpu_elems, cpu_time = [], 0, 0.0
+    for i in range(n_docs):
+        u, _ = gen_trace(n_ops, seed=1000 + i)
+        updates.append(u)
+        rate, n_el = cpu_apply_rate(u)
+        cpu_elems += n_el
+        cpu_time += n_el / rate if rate else 0.0
+
+    # compile warmup: an identically-shaped engine run (fresh engine, same
+    # updates -> same padded bucket shapes -> compile cache hit in the timed
+    # run).  Steady-state server behavior; compile time excluded, as stated.
+    warm = BatchEngine(n_docs)
+    for i, u in enumerate(updates):
+        warm.queue_update(i, u)
+    warm.flush()
+    np.asarray(warm._right[:, 0])
+
+    eng = BatchEngine(n_docs)
+    t0 = time.perf_counter()
+    for i, u in enumerate(updates):
+        eng.queue_update(i, u)
+    eng.flush()
+    # readback barrier: force device completion
+    np.asarray(eng._right[:, 0])
+    t_e2e = time.perf_counter() - t0
+
+    # convergence spot-check on 3 docs (distinct traces -> meaningful)
+    import yjs_tpu as Y
+
+    for i in random.Random(3).sample(range(n_docs), min(3, n_docs)):
+        d = Y.Doc(gc=False)
+        Y.apply_update(d, updates[i])
+        if eng.text(i) != d.get_text("text").to_string():
+            print(json.dumps({"metric": "FAILED_distinct_convergence",
+                              "value": 0, "unit": "", "vs_baseline": 0}))
+            sys.exit(1)
+
+    m = eng.last_flush_metrics or {}
+    return (
+        {
+            "n_docs": n_docs,
+            "trace_ops": n_ops,
+            "total_elems": cpu_elems,
+            "e2e_elems_per_sec": round(cpu_elems / t_e2e, 1),
+            "cpu_py_elems_per_sec": round(cpu_elems / cpu_time, 1) if cpu_time else 0,
+            "t_e2e_s": round(t_e2e, 4),
+            "host_phase_timers_s": {
+                k: round(m.get(k, 0.0), 4)
+                for k in ("t_plan_s", "t_pack_s", "t_dispatch_s")
+            },
+            "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
+            "n_demoted": m.get("n_demoted", 0),
+        },
+        eng,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Variant 3: batched sync step 2 (state-vector diff) over all distinct docs
+# ---------------------------------------------------------------------------
+
+
+def bench_sync(eng, n_docs: int) -> dict:
+    # every doc answers a fresh peer (empty SV -> full-state diff): one
+    # diff_mask_kernel dispatch + per-doc host wire encode
+    requests = [(i, {}) for i in range(n_docs)]
+    t0 = time.perf_counter()
+    replies = eng.sync_step2_batch(requests)
+    dt = time.perf_counter() - t0
+    total_bytes = sum(len(r) for r in replies)
+    return {
+        "n_docs": n_docs,
+        "syncs_per_sec": round(n_docs / dt, 1),
+        "encoded_mb_per_sec": round(total_bytes / dt / 1e6, 2),
+        "t_total_s": round(dt, 4),
+    }
+
+
+def main():
+    n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
+    n_docs_distinct = int(os.environ.get("YTPU_BENCH_DISTINCT_DOCS", "64"))
+    n_ops = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
+
+    b4 = bench_b4_broadcast(n_docs_b4)
+    distinct, eng = bench_distinct(n_docs_distinct, n_ops)
+    sync = bench_sync(eng, n_docs_distinct)
+
+    node_proxy_b4 = b4["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
+    headline = b4["e2e_elems_per_sec"]
     result = {
-        "metric": "batched_apply_update_elements_per_sec",
-        "value": round(device_rate, 1),
-        "unit": f"elem/s ({n_docs} docs x {n_elements} elems; host transcode "
-                f"{transcode_time*1e3:.0f}ms excluded; cpu ref {cpu_rate:,.0f}/s)",
-        "vs_baseline": round(device_rate / cpu_rate, 2),
+        "metric": "b4_replay_e2e_elements_per_sec",
+        "value": headline,
+        "unit": (
+            f"elem/s end-to-end ({b4['n_docs']} docs x {b4['elems_per_doc']} "
+            f"elems broadcast; incl. host transcode+pack; vs Node PROXY = "
+            f"python_core x{NODE_PROXY_FACTOR:g}, see BASELINE.md)"
+        ),
+        "vs_baseline": round(headline / node_proxy_b4, 2) if node_proxy_b4 else 0,
+        "detail": {
+            "b4_broadcast": b4,
+            "distinct_engine_path": distinct,
+            "sync_step2_batched": sync,
+            "node_proxy_factor": NODE_PROXY_FACTOR,
+            "node_proxy_b4_elems_per_sec": round(node_proxy_b4, 1),
+            "distinct_e2e_vs_python": round(
+                distinct["e2e_elems_per_sec"]
+                / max(1.0, distinct["cpu_py_elems_per_sec"]),
+                2,
+            ),
+        },
     }
     print(json.dumps(result))
 

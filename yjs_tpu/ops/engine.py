@@ -62,9 +62,9 @@ def _bucket(n: int, minimum: int = 64) -> int:
 
 
 def _phase(name: str):
-    """jax.profiler annotation around one flush phase when profiling is on
-    (YTPU_PROFILE_DIR or an active jax.profiler trace) — the per-phase
-    tracing SURVEY.md §5 calls for; a no-op otherwise."""
+    """jax.profiler annotation around one flush phase — visible in any
+    active jax.profiler trace (the per-phase tracing SURVEY.md §5 calls
+    for); free when no trace is being captured."""
     if not HAS_JAX:
         return contextlib.nullcontext()
     return jax.profiler.TraceAnnotation(f"ytpu.{name}")
@@ -284,25 +284,29 @@ class BatchEngine:
                     self._demote(i, pre_svs.get(i), reason=str(e))
                     demoted_now += 1
         t_plan = time.perf_counter()
+        # one schema for both exits: the normal path overwrites the measured
+        # fields below, so the metrics dict cannot drift between the two
+        metrics = {
+            "n_docs_flushed": 0,
+            "n_demoted": demoted_now,
+            "n_fallback_docs": len(self.fallback),
+            "n_rows_max": 0,
+            "n_sched_entries": 0,
+            "n_levels": 0,
+            "level_width": 0,
+            "schedule_occupancy": 0.0,
+            "n_pending_docs": 0,
+            "pending_depth": 0,
+            "t_compact_s": t_compact - t_start,
+            "t_plan_s": t_plan - t_compact,
+            "t_pack_s": 0.0,
+            "t_dispatch_s": 0.0,
+            "t_emit_s": 0.0,
+            "t_total_s": 0.0,
+        }
         if not plans:
-            self.last_flush_metrics = {
-                "n_docs_flushed": 0,
-                "n_demoted": demoted_now,
-                "n_fallback_docs": len(self.fallback),
-                "n_rows_max": 0,
-                "n_sched_entries": 0,
-                "n_levels": 0,
-                "level_width": 0,
-                "schedule_occupancy": 0.0,
-                "n_pending_docs": 0,
-                "pending_depth": 0,
-                "t_compact_s": t_compact - t_start,
-                "t_plan_s": t_plan - t_compact,
-                "t_pack_s": 0.0,
-                "t_dispatch_s": 0.0,
-                "t_emit_s": 0.0,
-                "t_total_s": time.perf_counter() - t_start,
-            }
+            metrics["t_total_s"] = time.perf_counter() - t_start
+            self.last_flush_metrics = metrics
             return
         with _phase("pack"):
             n_splits = _bucket(
@@ -408,14 +412,12 @@ class BatchEngine:
         n_sched_entries = sum(len(p.sched6) for p in plans.values())
         lv_slots = b * n_lv * w_lv
         pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
-        self.last_flush_metrics = {
+        metrics.update({
             "n_docs_flushed": sum(
                 1
                 for p in plans.values()
                 if p.sched6 or p.splits or p.delete_rows
             ),
-            "n_demoted": demoted_now,
-            "n_fallback_docs": len(self.fallback),
             "n_rows_max": max_rows,
             "n_sched_entries": n_sched_entries,
             "n_levels": n_lv,
@@ -428,13 +430,12 @@ class BatchEngine:
                 + len(self.mirrors[i].pending_ds)
                 for i in pending_docs
             ),
-            "t_compact_s": t_compact - t_start,
-            "t_plan_s": t_plan - t_compact,
             "t_pack_s": t_pack - t_plan,
             "t_dispatch_s": t_dispatch - t_pack,
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
-        }
+        })
+        self.last_flush_metrics = metrics
 
     @property
     def last_metrics(self) -> dict | None:

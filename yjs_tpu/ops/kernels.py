@@ -340,6 +340,22 @@ def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base)
     )
 
 
+@functools.partial(jax.jit, donate_argnums=(1,))
+def batch_step_levels_shared(
+    statics, dyn, splits, lv_sched, delete_rows, scratch_base
+):
+    """Level-parallel step where ALL docs share one schedule + static table
+    (the broadcast-replay shape: one update fanned out to a whole batch).
+
+    statics/splits/lv_sched/delete_rows carry NO doc axis; vmap in_axes=None
+    lets XLA fuse the implicit broadcast, so HBM and the host->device link
+    hold ONE copy of the static columns instead of B.
+    """
+    return jax.vmap(
+        _doc_step_levels, in_axes=(None, 0, None, None, None, 0)
+    )(statics, dyn, splits, lv_sched, delete_rows, scratch_base)
+
+
 # ---------------------------------------------------------------------------
 # export / sync kernels
 # ---------------------------------------------------------------------------
