@@ -55,8 +55,86 @@ def load():
     lib.ytpu_count_v1.argtypes = [u8p, ctypes.c_uint64, u64p, u64p]
     lib.ytpu_decode_v1.restype = ctypes.c_int
     lib.ytpu_decode_v1.argtypes = [u8p, ctypes.c_uint64] + [i64p] * 19
+    lib.ytpu_count_v2.restype = ctypes.c_int
+    lib.ytpu_count_v2.argtypes = [u8p, ctypes.c_uint64, u64p, u64p]
+    lib.ytpu_decode_v2.restype = ctypes.c_int
+    lib.ytpu_decode_v2.argtypes = [u8p, ctypes.c_uint64] + [i64p] * 22
+    lib.ytpu_encode_v1.restype = ctypes.c_int64
+    lib.ytpu_encode_v1.argtypes = (
+        [ctypes.POINTER(u8p), u64p, ctypes.c_uint64]      # bufs
+        + [i64p] * 3 + [ctypes.c_uint64]                  # row groups
+        + [i64p] * 16                                     # row columns
+        + [u8p, ctypes.c_uint64]                          # strings blob
+        + [i64p] * 3 + [ctypes.c_uint64] + [i64p] * 2     # ds groups
+        + [u8p, ctypes.c_uint64]                          # out
+    )
     _lib = lib
     return _lib
+
+
+# content-source kinds for ytpu_encode_v1 (must match transcode.cpp)
+SRC_NONE, SRC_DELETED, SRC_FRAMED, SRC_UTF8, SRC_SPILL = 0, 1, 2, 3, 4
+
+
+def encode_v1_update(
+    bufs: list[bytes],
+    group_client, group_start, group_len,
+    row_cols: dict,
+    strings: bytes,
+    ds_group_client, ds_group_start, ds_group_len,
+    ds_clock, ds_len,
+    out_cap: int,
+) -> bytes:
+    """Assemble a V1 update natively from pre-marshalled columns.  All
+    array arguments are int64 numpy arrays; ``row_cols`` holds the 16
+    per-row columns in ABI order.  Raises NativeDecodeError when the
+    library is unavailable or encoding fails (caller falls back to the
+    Python encoder)."""
+    lib = load()
+    if lib is None:
+        raise NativeDecodeError("native transcoder unavailable")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    n_bufs = len(bufs)
+    buf_arrs = [np.frombuffer(b, dtype=np.uint8) for b in bufs]
+    buf_ptrs = (u8p * max(1, n_bufs))(
+        *(a.ctypes.data_as(u8p) for a in buf_arrs)
+    )
+    buf_lens = np.asarray([len(b) for b in bufs], np.uint64)
+    strings_a = np.frombuffer(strings, dtype=np.uint8) if strings else np.zeros(1, np.uint8)
+    out = np.empty(out_cap, np.uint8)
+    row_order = (
+        "clock", "length", "offset",
+        "origin_client", "origin_clock", "right_client", "right_clock",
+        "content_ref", "name_ofs", "name_len", "sub_ofs", "sub_len",
+        "src_kind", "src_buf", "src_ofs", "src_end",
+    )
+    # materialize every array first: the ctypes pointers do not keep their
+    # backing buffers alive
+    keep = (
+        [np.ascontiguousarray(a, np.int64)
+         for a in (group_client, group_start, group_len)]
+        + [np.ascontiguousarray(row_cols[k], np.int64) for k in row_order]
+        + [np.ascontiguousarray(a, np.int64)
+           for a in (ds_group_client, ds_group_start, ds_group_len,
+                     ds_clock, ds_len)]
+    )
+    i64ptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    rc = lib.ytpu_encode_v1(
+        buf_ptrs,
+        buf_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n_bufs,
+        i64ptr(keep[0]), i64ptr(keep[1]), i64ptr(keep[2]),
+        len(keep[0]),
+        *(i64ptr(a) for a in keep[3:19]),
+        strings_a.ctypes.data_as(u8p), len(strings),
+        i64ptr(keep[19]), i64ptr(keep[20]), i64ptr(keep[21]),
+        len(keep[19]),
+        i64ptr(keep[22]), i64ptr(keep[23]),
+        out.ctypes.data_as(u8p), out_cap,
+    )
+    if rc < 0:
+        raise NativeDecodeError(f"native encode failed: {rc}")
+    return out[:rc].tobytes()
 
 
 class NativeDecodeError(Exception):
@@ -107,4 +185,47 @@ def decode_v1_columns(update: bytes):
     )
     if rc != 0:
         raise NativeDecodeError(f"decode pass failed: {rc}")
+    return cols, ds
+
+
+_V2_COLS = (
+    "client", "clock", "length",
+    "origin_client", "origin_clock", "right_client", "right_clock",
+    "info", "parent_name_ofs", "parent_name_len",
+    "parent_id_client", "parent_id_clock",
+    "parent_sub_ofs", "parent_sub_len",
+    "content_ofs", "content_end", "content_ofs2", "content_end2",
+    "content_count",
+)
+
+
+def decode_v2_columns(update: bytes):
+    """Decode a V2 columnar update (the 9-stream container, reference
+    UpdateDecoder.js:270-293) into int64 column arrays via the native
+    scanner.  String contents stay lazy as byte ranges into the in-buffer
+    UTF-8 arena; rest-stream payloads (binary/embed/any) as self-delimiting
+    byte ranges.  Raises NativeDecodeError when unavailable, on malformed
+    input, or on legacy ContentJSON / subdoc ContentDoc payloads (caller
+    falls back to the Python decoder)."""
+    lib = load()
+    if lib is None:
+        raise NativeDecodeError("native transcoder unavailable")
+    buf = np.frombuffer(update, dtype=np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    n_structs = ctypes.c_uint64()
+    n_ds = ctypes.c_uint64()
+    rc = lib.ytpu_count_v2(bp, len(update), ctypes.byref(n_structs), ctypes.byref(n_ds))
+    if rc != 0:
+        raise NativeDecodeError(f"v2 count pass failed: {rc}")
+    ns, nd = n_structs.value, n_ds.value
+    cols = {k: np.empty(ns, np.int64) for k in _V2_COLS}
+    ds = {k: np.empty(nd, np.int64) for k in ("client", "clock", "len")}
+    ptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    rc = lib.ytpu_decode_v2(
+        bp, len(update),
+        *(ptr(cols[k]) for k in _V2_COLS),
+        ptr(ds["client"]), ptr(ds["clock"]), ptr(ds["len"]),
+    )
+    if rc != 0:
+        raise NativeDecodeError(f"v2 decode pass failed: {rc}")
     return cols, ds

@@ -57,19 +57,100 @@ class LazyContent:
 
     The native transcoder (yjs_tpu/native) emits byte offsets instead of
     decoding payloads; most rows are never materialized (state vectors,
-    diffs, integration itself need no payload bytes)."""
+    diffs, integration itself need no payload bytes).  ``end`` is the
+    exclusive end of the V1-framed payload bytes: the native wire encoder
+    copies [ofs, end) verbatim when re-emitting unsplit rows."""
 
-    __slots__ = ("buf", "ofs", "ref")
+    __slots__ = ("buf", "ofs", "end", "ref")
 
-    def __init__(self, buf: bytes, ofs: int, ref: int):
+    def __init__(self, buf: bytes, ofs: int, ref: int, end: int = -1):
         self.buf = buf
         self.ofs = ofs
+        self.end = end
         self.ref = ref
 
     def realize(self):
         decoder = Decoder(self.buf)
         decoder.pos = self.ofs
         return read_item_content(UpdateDecoderV1(decoder), self.ref)
+
+
+class _TypeNameShim:
+    """Minimal decoder stand-in for type_refs constructors (only XmlElement
+    and XmlHook read anything: the node/hook name string)."""
+
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str | None):
+        self._name = name
+
+    def read_string(self) -> str:
+        return self._name
+
+    read_key = read_string
+
+
+class LazyContentV2:
+    """V2 content payload as byte ranges into the update's stream regions
+    (UTF-8 string arena / self-delimiting rest-stream values), decoded on
+    demand — the V2 twin of :class:`LazyContent` (reference
+    UpdateDecoder.js:270-293 stream layout)."""
+
+    __slots__ = ("buf", "kind", "ofs", "end", "ofs2", "end2", "count")
+
+    def __init__(self, buf, kind, ofs, end, ofs2, end2, count):
+        self.buf = buf
+        self.kind = kind
+        self.ofs = ofs
+        self.end = end
+        self.ofs2 = ofs2
+        self.end2 = end2
+        self.count = count
+
+    def _any_at(self, ofs: int):
+        d = Decoder(self.buf)
+        d.pos = ofs
+        return decoding.read_any(d)
+
+    def realize(self):
+        from ..core import (
+            ContentBinary,
+            ContentEmbed,
+            ContentFormat,
+            ContentString,
+            ContentType,
+            type_refs,
+        )
+        from ..lib0.u16 import utf8_decode_u16
+
+        k = self.kind
+        if k == 4:
+            return ContentString(utf8_decode_u16(self.buf[self.ofs : self.end]))
+        if k == 8:
+            from ..core import ContentAny
+
+            d = Decoder(self.buf)
+            d.pos = self.ofs
+            return ContentAny([decoding.read_any(d) for _ in range(self.count)])
+        if k == 6:
+            return ContentFormat(
+                utf8_decode_u16(self.buf[self.ofs : self.end]),
+                self._any_at(self.ofs2),
+            )
+        if k == 5:
+            return ContentEmbed(self._any_at(self.ofs))
+        if k == 3:
+            d = Decoder(self.buf)
+            d.pos = self.ofs
+            return ContentBinary(decoding.read_var_uint8_array(d))
+        if k == 7:
+            name = (
+                utf8_decode_u16(self.buf[self.ofs : self.end])
+                if self.ofs >= 0
+                else None
+            )
+            return ContentType(type_refs[self.count](_TypeNameShim(name)))
+        raise ValueError(f"unexpected lazy v2 content kind {k}")
 
 
 @dataclass(slots=True)
@@ -89,7 +170,7 @@ class ItemRef:
     is_gc: bool = False
 
     def materialize(self):
-        if isinstance(self.content, LazyContent):
+        if isinstance(self.content, (LazyContent, LazyContentV2)):
             self.content = self.content.realize()
         return self.content
 
@@ -132,14 +213,15 @@ def decode_update_refs(update: bytes, v2: bool):
     nothing — root parents stay names, origins stay IDs.  V1 updates take
     the native columnar scanner when available (payloads stay lazy).
     """
-    if not v2:
-        from ..native import NativeDecodeError
+    from ..native import NativeDecodeError
 
-        try:
-            return _decode_update_refs_native(update)
-        except NativeDecodeError:
-            pass  # no toolchain / malformed input: pure-Python decoder
-            # decides whether the bytes are really malformed
+    try:
+        if v2:
+            return _decode_update_refs_native_v2(update)
+        return _decode_update_refs_native(update)
+    except NativeDecodeError:
+        pass  # no toolchain / malformed input / legacy payload kinds: the
+        # pure-Python decoder decides whether the bytes are really malformed
     decoder = Decoder(update)
     yd = UpdateDecoderV2(decoder) if v2 else UpdateDecoderV1(decoder)
     refs: dict[int, list[ItemRef]] = {}
@@ -219,7 +301,7 @@ def _decode_update_refs_native(update: bytes):
     pno, pnl = cols["parent_name_ofs"], cols["parent_name_len"]
     pic, pik = cols["parent_id_client"], cols["parent_id_clock"]
     pso, psl = cols["parent_sub_ofs"], cols["parent_sub_len"]
-    c_ofs = cols["content_ofs"]
+    c_ofs, c_end = cols["content_ofs"], cols["content_end"]
     for i in range(n):
         client = int(client_a[i])
         ref_kind = int(info_a[i]) & BITS5
@@ -242,7 +324,70 @@ def _decode_update_refs_native(update: bytes):
                 parent_sub=None
                 if pso[i] < 0
                 else utf8_decode_u16(update[int(pso[i]) : int(pso[i]) + int(psl[i])]),
-                content=LazyContent(update, int(c_ofs[i]), int(info_a[i])),
+                content=LazyContent(
+                    update, int(c_ofs[i]), int(info_a[i]), int(c_end[i])
+                ),
+                content_ref=ref_kind,
+            )
+        refs.setdefault(client, []).append(ref)
+    ds = [
+        (int(c), int(k), int(ln))
+        for c, k, ln in zip(ds_cols["client"], ds_cols["clock"], ds_cols["len"])
+    ]
+    return refs, ds
+
+
+def _decode_update_refs_native_v2(update: bytes):
+    """Build ItemRefs from the native V2 scanner's columns."""
+    from ..core import ContentDeleted
+    from ..lib0.u16 import utf8_decode_u16
+    from ..native import decode_v2_columns
+
+    cols, ds_cols = decode_v2_columns(update)
+    refs: dict[int, list[ItemRef]] = {}
+    n = len(cols["client"])
+    client_a = cols["client"]
+    clock_a = cols["clock"]
+    length_a = cols["length"]
+    oc, ok = cols["origin_client"], cols["origin_clock"]
+    rc, rk = cols["right_client"], cols["right_clock"]
+    info_a = cols["info"]
+    pno, pnl = cols["parent_name_ofs"], cols["parent_name_len"]
+    pic, pik = cols["parent_id_client"], cols["parent_id_clock"]
+    pso, psl = cols["parent_sub_ofs"], cols["parent_sub_len"]
+    c_ofs, c_end = cols["content_ofs"], cols["content_end"]
+    c_ofs2, c_end2 = cols["content_ofs2"], cols["content_end2"]
+    c_cnt = cols["content_count"]
+    for i in range(n):
+        client = int(client_a[i])
+        ref_kind = int(info_a[i]) & BITS5
+        if ref_kind == 0:
+            ref = ItemRef(
+                client=client, clock=int(clock_a[i]), length=int(length_a[i]),
+                is_gc=True,
+            )
+        else:
+            if ref_kind == 1:
+                content = ContentDeleted(int(length_a[i]))
+            else:
+                content = LazyContentV2(
+                    update, ref_kind, int(c_ofs[i]), int(c_end[i]),
+                    int(c_ofs2[i]), int(c_end2[i]), int(c_cnt[i]),
+                )
+            ref = ItemRef(
+                client=client,
+                clock=int(clock_a[i]),
+                length=int(length_a[i]),
+                origin=None if oc[i] < 0 else (int(oc[i]), int(ok[i])),
+                right_origin=None if rc[i] < 0 else (int(rc[i]), int(rk[i])),
+                parent_name=None
+                if pno[i] < 0
+                else utf8_decode_u16(update[int(pno[i]) : int(pno[i]) + int(pnl[i])]),
+                parent_id=None if pic[i] < 0 else (int(pic[i]), int(pik[i])),
+                parent_sub=None
+                if pso[i] < 0
+                else utf8_decode_u16(update[int(pso[i]) : int(pso[i]) + int(psl[i])]),
+                content=content,
                 content_ref=ref_kind,
             )
         refs.setdefault(client, []).append(ref)
@@ -409,6 +554,32 @@ class DocMirror:
         self.row_content: list[object | None] = []
         self.row_content_ref: list[int] = []
         self.row_seg: list[int] = []  # segment id (NULL for GC rows)
+        # per-row content source for the native wire encoder (kind codes
+        # from yjs_tpu.native: NONE/DELETED/FRAMED/UTF8/SPILL), precomputed
+        # at row creation so encode never inspects content objects
+        self.row_src_kind: list[int] = []
+        self.row_src_buf: list[int] = []
+        self.row_src_ofs: list[int] = []
+        self.row_src_end: list[int] = []
+        # source-buffer registry backing row_src_buf
+        self._bufs: list[bytes] = []
+        self._buf_ids: dict[int, int] = {}
+        # persistent interned segment-name strings blob (UTF-8)
+        self._strings = bytearray()
+        self._interned: dict[str, tuple[int, int]] = {}
+        # per-seg interned name/sub offsets, aligned with seg_info
+        self.seg_name_ofs: list[int] = []
+        self.seg_name_len: list[int] = []
+        self.seg_sub_ofs: list[int] = []
+        self.seg_sub_len: list[int] = []
+        # numpy-view cache of the row columns, invalidated on any mutation
+        self._gen = 0
+        self._np_gen = -1
+        self._np: dict[str, np.ndarray] = {}
+        # merged delete-set arrays cache (grouped for the native encoder)
+        self._ds_gen = 0
+        self._ds_np_gen = -1
+        self._ds_np: tuple | None = None
         # per-slot fragment index, sorted by clock
         self.frag_clock: list[list[int]] = []
         self.frag_row: list[list[int]] = []
@@ -447,6 +618,26 @@ class DocMirror:
 
     # -- segments -----------------------------------------------------------
 
+    def _intern(self, s: str) -> tuple[int, int]:
+        r = self._interned.get(s)
+        if r is None:
+            from ..lib0.u16 import u16_encode_utf8
+
+            b = u16_encode_utf8(s)
+            r = (len(self._strings), len(b))
+            self._interned[s] = r
+            self._strings.extend(b)
+        return r
+
+    def _buf_idx(self, b) -> int:
+        k = id(b)
+        j = self._buf_ids.get(k)
+        if j is None:
+            j = len(self._bufs)
+            self._buf_ids[k] = j
+            self._bufs.append(b)
+        return j
+
     def seg(self, name: str, sub: str | None = None) -> int:
         key = (name, sub)
         s = self.segments.get(key)
@@ -454,6 +645,16 @@ class DocMirror:
             s = len(self.seg_info)
             self.segments[key] = s
             self.seg_info.append(key)
+            no, nl = self._intern(name)
+            self.seg_name_ofs.append(no)
+            self.seg_name_len.append(nl)
+            if sub is None:
+                self.seg_sub_ofs.append(NULL)
+                self.seg_sub_len.append(0)
+            else:
+                so, sl = self._intern(sub)
+                self.seg_sub_ofs.append(so)
+                self.seg_sub_len.append(sl)
         return s
 
     @property
@@ -490,6 +691,42 @@ class DocMirror:
         self.row_content.append(content)
         self.row_content_ref.append(content_ref)
         self.row_seg.append(NULL if is_gc else seg)
+        # content source for the native encoder
+        from ..native import SRC_DELETED, SRC_FRAMED, SRC_NONE, SRC_SPILL, SRC_UTF8
+
+        if is_gc:
+            kind, sb, so, se = SRC_NONE, NULL, NULL, NULL
+        elif content_ref == 1:
+            kind, sb, so, se = SRC_DELETED, NULL, NULL, NULL
+        elif isinstance(content, LazyContent) and content.end >= 0:
+            if content_ref == 4:
+                # skip the var_string length prefix: raw UTF-8 range
+                b, p = content.buf, content.ofs
+                blen = 0
+                shift = 0
+                while True:
+                    c = b[p]
+                    p += 1
+                    blen |= (c & 0x7F) << shift
+                    shift += 7
+                    if c < 0x80:
+                        break
+                kind, sb, so, se = SRC_UTF8, self._buf_idx(b), p, p + blen
+            else:
+                kind = SRC_FRAMED
+                sb = self._buf_idx(content.buf)
+                so, se = content.ofs, content.end
+        elif isinstance(content, LazyContentV2) and content.kind == 4:
+            kind = SRC_UTF8
+            sb = self._buf_idx(content.buf)
+            so, se = content.ofs, content.end
+        else:
+            kind, sb, so, se = SRC_SPILL, NULL, NULL, NULL
+        self.row_src_kind.append(kind)
+        self.row_src_buf.append(sb)
+        self.row_src_ofs.append(so)
+        self.row_src_end.append(se)
+        self._gen += 1
         if is_gc:
             # GC structs are always deleted: they belong in the derived
             # DeleteSet (reference DeleteSet.js createDeleteSetFromStructStore)
@@ -522,7 +759,7 @@ class DocMirror:
     def realized_content(self, row: int):
         """The row's content object, decoding the lazy payload on demand."""
         content = self.row_content[row]
-        if isinstance(content, LazyContent):
+        if isinstance(content, (LazyContent, LazyContentV2)):
             content = content.realize()
             self.row_content[row] = content
         return content
@@ -533,6 +770,12 @@ class DocMirror:
         row = self.frag_row[slot][frag_idx]
         offset = at_clock - self.row_clock[row]
         right_content = self.realized_content(row).splice(offset)
+        # the row's content is now a realized, truncated object: its lazy
+        # byte range no longer matches — the encoder must re-frame it
+        from ..native import SRC_SPILL
+
+        self.row_src_kind[row] = SRC_SPILL
+        self._gen += 1
         seg = self.row_seg[row]
         new_row = self._add_row(
             slot,
@@ -877,6 +1120,7 @@ class DocMirror:
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
         ranges = self.ds.setdefault(slot, [])
         ranges.append((clock, ln))
+        self._ds_gen += 1
 
     # -- exports ------------------------------------------------------------
 
@@ -912,6 +1156,8 @@ class DocMirror:
                 r = int(right_link[r])
             order_of_seg[seg] = out
 
+        from ..native import SRC_DELETED, SRC_SPILL
+
         # GC pass: deleted content -> tombstone (payload freed)
         if gc:
             for row in range(n):
@@ -923,6 +1169,7 @@ class DocMirror:
                     self.row_content[row] = ContentDeleted(self.row_len[row])
                     self.row_content_ref[row] = 1
                     self.row_countable[row] = False
+                    self.row_src_kind[row] = SRC_DELETED
 
         # merge pass: list segments right-to-left; GC rows by clock order
         absorbed: dict[int, int] = {}  # dead row -> surviving head row
@@ -959,6 +1206,8 @@ class DocMirror:
                 a, b = order[i], order[i + 1]
                 if try_merge(a, b):
                     self.row_len[a] += self.row_len[b]
+                    if self.row_src_kind[a] != SRC_DELETED:
+                        self.row_src_kind[a] = SRC_SPILL  # merged content
                     absorbed[b] = a
                     order.pop(i + 1)
                 else:
@@ -1015,6 +1264,21 @@ class DocMirror:
         self.row_content = take(self.row_content)
         self.row_content_ref = take(self.row_content_ref)
         self.row_seg = take(self.row_seg)
+        self.row_src_kind = take(self.row_src_kind)
+        self.row_src_buf = take(self.row_src_buf)
+        self.row_src_ofs = take(self.row_src_ofs)
+        self.row_src_end = take(self.row_src_end)
+        # prune the source-buffer registry: compaction tombstones/merges
+        # rows, and buffers no surviving row references must not stay
+        # pinned for the mirror's lifetime
+        used = sorted({b for b in self.row_src_buf if b >= 0})
+        remap = {old: new for new, old in enumerate(used)}
+        self._bufs = [self._bufs[b] for b in used]
+        self._buf_ids = {id(b): j for j, b in enumerate(self._bufs)}
+        self.row_src_buf = [
+            remap[b] if b >= 0 else b for b in self.row_src_buf
+        ]
+        self._gen += 1
         # fragment index: rebuild from the surviving rows (clock-sorted)
         n_slots = len(self.client_of_slot)
         self.frag_clock = [[] for _ in range(n_slots)]
@@ -1099,21 +1363,23 @@ class DocMirror:
         the update is byte-valid and state-equivalent, like any Yjs update.
         """
         target_sv = target_sv or {}
-        # host twin of kernels.diff_mask_kernel (the engine's batched sync
-        # path computes the same mask for many docs in one dispatch)
-        n = self.n_rows
-        needed = np.zeros(n, bool)
-        offset = np.zeros(n, np.int64)
-        for slot, st in enumerate(self.state):
-            remote = target_sv.get(self.client_of_slot[slot], 0)
-            if st <= remote:
-                continue
-            for row in self.frag_row[slot]:
-                end = self.row_clock[row] + self.row_len[row]
-                if end > remote:
-                    needed[row] = True
-                    offset[row] = max(0, remote - self.row_clock[row])
+        needed, offset = self._diff_mask(target_sv)
         return self.encode_masked_update(needed, offset, v2=v2)
+
+    def _diff_mask(self, remote_sv: dict[int, int]):
+        """Vectorized host twin of kernels.diff_mask_kernel: rows (or row
+        suffixes) beyond a remote state vector (encoding.js:94-116)."""
+        n = self.n_rows
+        if n == 0:
+            return np.zeros(0, bool), np.zeros(0, np.int64)
+        c = self._np_cols()
+        remote_of_slot = np.asarray(
+            [remote_sv.get(cl, 0) for cl in self.client_of_slot], np.int64
+        )
+        remote = remote_of_slot[np.asarray(self.row_slot, np.int64)]
+        needed = c["row_end"] > remote
+        offset = np.where(needed, np.clip(remote - c["clock"], 0, None), 0)
+        return needed, offset
 
     def encode_step_update(self, pre_sv: dict[int, int], plan: StepPlan,
                            v2: bool = False) -> bytes | None:
@@ -1121,21 +1387,8 @@ class DocMirror:
         pre-flush state vector + the step's applied delete ranges — the
         engine's doc.on('update') payload (reference Transaction.js:339-352
         emits exactly the transaction's novelty)."""
-        n = self.n_rows
-        needed = np.zeros(n, bool)
-        offset = np.zeros(n, np.int64)
-        any_rows = False
-        for slot, st in enumerate(self.state):
-            known = pre_sv.get(self.client_of_slot[slot], 0)
-            if st <= known:
-                continue
-            for row in self.frag_row[slot]:
-                end = self.row_clock[row] + self.row_len[row]
-                if end > known:
-                    needed[row] = True
-                    offset[row] = max(0, known - self.row_clock[row])
-                    any_rows = True
-        if not any_rows and not plan.applied_ds:
+        needed, offset = self._diff_mask(pre_sv)
+        if not needed.any() and not plan.applied_ds:
             return None
         return self.encode_masked_update(
             needed, offset, v2=v2, ds_ranges=plan.applied_ds
@@ -1151,6 +1404,16 @@ class DocMirror:
         from ..coding import UpdateEncoderV1, UpdateEncoderV2
         from ..core import write_delete_set
         from ..lib0 import encoding as lib0enc
+
+        if not v2:
+            from ..native import NativeDecodeError
+
+            try:
+                return self._encode_masked_update_native(
+                    needed, offset, ds_ranges
+                )
+            except NativeDecodeError:
+                pass  # no toolchain: pure-Python writer below
 
         encoder = UpdateEncoderV2() if v2 else UpdateEncoderV1()
         # clients with news, descending id ("heavily improves the conflict
@@ -1182,6 +1445,215 @@ class DocMirror:
             sort_and_merge_delete_set(ds)
         write_delete_set(encoder, ds)
         return encoder.to_bytes()
+
+    def _np_cols(self) -> dict[str, np.ndarray]:
+        """Numpy views of the encode-relevant row columns, rebuilt only when
+        the mirror mutated since the last build (generation counter)."""
+        if self._np_gen == self._gen:
+            return self._np
+        client_of_slot = np.asarray(self.client_of_slot, np.int64)
+        resolve = lambda slots: np.where(
+            slots >= 0, client_of_slot[np.clip(slots, 0, None)], NULL
+        )
+        oslot = np.asarray(self.row_origin_slot, np.int64)
+        rslot = np.asarray(self.row_right_slot, np.int64)
+        seg = np.asarray(self.row_seg, np.int64)
+        safe_seg = np.clip(seg, 0, None)
+        seg_gather = lambda col, fill: np.where(
+            seg >= 0,
+            np.asarray(col, np.int64)[safe_seg] if len(col) else NULL,
+            fill,
+        )
+        c = {
+            "slot": np.asarray(self.row_slot, np.int64),
+            "client": resolve(np.asarray(self.row_slot, np.int64)),
+            "clock": np.asarray(self.row_clock, np.int64),
+            "length": np.asarray(self.row_len, np.int64),
+            "origin_client": resolve(oslot),
+            "origin_clock": np.asarray(self.row_origin_clock, np.int64),
+            "right_client": resolve(rslot),
+            "right_clock": np.asarray(self.row_right_clock, np.int64),
+            "content_ref": np.asarray(self.row_content_ref, np.int64),
+            "src_kind": np.asarray(self.row_src_kind, np.int64),
+            "src_buf": np.asarray(self.row_src_buf, np.int64),
+            "src_ofs": np.asarray(self.row_src_ofs, np.int64),
+            "src_end": np.asarray(self.row_src_end, np.int64),
+            "name_ofs": seg_gather(self.seg_name_ofs, NULL),
+            "name_len": seg_gather(self.seg_name_len, 0),
+            "sub_ofs": seg_gather(self.seg_sub_ofs, NULL),
+            "sub_len": seg_gather(self.seg_sub_len, 0),
+        }
+        c["row_end"] = c["clock"] + c["length"]
+        # write order: client descending, clock ascending (encoding.js:112)
+        c["order"] = np.lexsort((c["clock"], -c["client"]))
+        self._np = c
+        self._np_gen = self._gen
+        return c
+
+    def _encode_masked_update_native(self, needed, offset,
+                                     ds_ranges=None) -> bytes:
+        """Gather the masked rows from the cached numpy columns and let the
+        C++ writer assemble the V1 update (ytpu_encode_v1).  Content bytes
+        memcpy straight from the source update buffers the rows were decoded
+        from (LazyContent / V2 arena ranges, precomputed at row creation);
+        realized or partially-written non-string contents are pre-framed
+        into a spill buffer by the Python encoder."""
+        from ..coding import UpdateEncoderV1
+        from ..core import sort_and_merge_delete_set
+        from ..native import (
+            SRC_FRAMED,
+            SRC_SPILL,
+            NativeDecodeError,
+            encode_v1_update,
+            load,
+        )
+
+        if load() is None:
+            raise NativeDecodeError("native transcoder unavailable")
+
+        c = self._np_cols()
+        n_rows = len(c["clock"])
+        needed = np.asarray(needed, bool)
+        offset = np.asarray(offset, np.int64)
+        if len(needed) < n_rows:
+            needed = np.pad(needed, (0, n_rows - len(needed)))
+            offset = np.pad(offset, (0, n_rows - len(offset)))
+        order = c["order"]
+        sel = order[needed[order]]
+        n = len(sel)
+        cols = {
+            k: c[k][sel]
+            for k in (
+                "clock", "length", "origin_client", "origin_clock",
+                "right_client", "right_clock", "content_ref",
+                "name_ofs", "name_len", "sub_ofs", "sub_len",
+                "src_kind", "src_buf", "src_ofs", "src_end",
+            )
+        }
+        cols["offset"] = offset[sel]
+        sel_client = c["client"][sel]
+
+        # client groups: contiguous runs in the descending-client order
+        if n:
+            bounds = np.flatnonzero(np.diff(sel_client) != 0) + 1
+            group_start = np.concatenate(([0], bounds))
+            group_len = np.diff(np.concatenate((group_start, [n])))
+            group_client = sel_client[group_start]
+        else:
+            group_start = group_len = group_client = np.zeros(0, np.int64)
+
+        # spill pass: realized contents and partial non-string first structs
+        spill_idx = np.flatnonzero(
+            (cols["src_kind"] == SRC_SPILL)
+            | ((cols["src_kind"] == SRC_FRAMED) & (cols["offset"] > 0))
+        )
+        spill = UpdateEncoderV1()
+        spill_buf = spill.rest_encoder.buf
+        for j in spill_idx:
+            row = int(sel[j])
+            pos0 = len(spill_buf)
+            self.realized_content(row).write(spill, int(cols["offset"][j]))
+            cols["src_kind"][j] = SRC_SPILL
+            cols["src_ofs"][j] = pos0
+            cols["src_end"][j] = len(spill_buf)
+        bufs = list(self._bufs)
+        spill_id = len(bufs)
+        bufs.append(bytes(spill_buf))
+        if len(spill_idx):
+            cols["src_buf"][spill_idx] = spill_id
+
+        content_bytes = int(
+            np.sum(
+                np.where(
+                    cols["src_end"] >= 0, cols["src_end"] - cols["src_ofs"], 10
+                )
+            )
+            + np.sum(cols["name_len"])
+            + np.sum(cols["sub_len"])
+        ) if n else 0
+        strings = self._strings
+
+        # DS section groups (write_delete_set order: dict iteration)
+        if ds_ranges is None:
+            (ds_group_client, ds_group_start, ds_group_len,
+             ds_clock, ds_len) = self._merged_ds_arrays()
+        else:
+            from ..core import DeleteItem, DeleteSet
+
+            ds = DeleteSet()
+            for client, clock, ln in ds_ranges:
+                ds.clients.setdefault(client, []).append(DeleteItem(clock, ln))
+            sort_and_merge_delete_set(ds)
+            ds_group_client = np.asarray(list(ds.clients.keys()), np.int64)
+            ds_group_len = np.asarray(
+                [len(v) for v in ds.clients.values()], np.int64
+            )
+            ds_group_start = np.zeros(len(ds.clients), np.int64)
+            if len(ds.clients) > 1:
+                ds_group_start[1:] = np.cumsum(ds_group_len)[:-1]
+            ds_clock = np.asarray(
+                [it.clock for v in ds.clients.values() for it in v], np.int64
+            )
+            ds_len = np.asarray(
+                [it.len for v in ds.clients.values() for it in v], np.int64
+            )
+
+        out_cap = (
+            64
+            + n * 80
+            + content_bytes
+            + 24 * (len(ds_clock) + len(ds_group_client))
+        )
+        return encode_v1_update(
+            bufs,
+            group_client, group_start, group_len,
+            cols,
+            bytes(strings),
+            ds_group_client, ds_group_start, ds_group_len,
+            ds_clock, ds_len,
+            out_cap,
+        )
+
+    def _merged_ds_arrays(self):
+        """The doc's derived DeleteSet as grouped, sorted+merged numpy
+        arrays (DeleteSet.js:113-135 semantics, vectorized and cached)."""
+        if self._ds_np_gen == self._ds_gen and self._ds_np is not None:
+            return self._ds_np
+        g_client, g_start, g_len = [], [], []
+        clocks, lens = [], []
+        pos = 0
+        for slot, ranges in self.ds.items():
+            if not ranges:
+                continue
+            a = np.asarray(ranges, np.int64).reshape(-1, 2)
+            o = np.argsort(a[:, 0], kind="stable")
+            cl, ln = a[o, 0], a[o, 1]
+            end = cl + ln
+            cummax = np.maximum.accumulate(end)
+            # new interval iff start > max end of everything before it
+            new_g = np.empty(len(cl), bool)
+            new_g[0] = True
+            new_g[1:] = cl[1:] > cummax[:-1]
+            idx = np.flatnonzero(new_g)
+            m_start = cl[idx]
+            last = np.concatenate((idx[1:] - 1, [len(cl) - 1]))
+            m_end = cummax[last]
+            g_client.append(self.client_of_slot[slot])
+            g_start.append(pos)
+            g_len.append(len(idx))
+            pos += len(idx)
+            clocks.append(m_start)
+            lens.append(m_end - m_start)
+        out = (
+            np.asarray(g_client, np.int64),
+            np.asarray(g_start, np.int64),
+            np.asarray(g_len, np.int64),
+            np.concatenate(clocks) if clocks else np.zeros(0, np.int64),
+            np.concatenate(lens) if lens else np.zeros(0, np.int64),
+        )
+        self._ds_np_gen = self._ds_gen
+        self._ds_np = out
+        return out
 
     def _write_row(self, encoder, row: int, offset: int) -> None:
         """Wire-encode one row (reference Item.js:625-658 / GC.js:45-48)."""

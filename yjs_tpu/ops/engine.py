@@ -552,7 +552,8 @@ class BatchEngine:
 
     def _sync_columns(self, docs: list[int]):
         """Stacked (row_slot, row_clock, row_end) columns for a doc subset,
-        padded to the widest doc (NULL rows are masked by the kernels)."""
+        padded to the widest doc (NULL rows are masked by the kernels).
+        Served from each mirror's cached numpy columns."""
         n = max((self.mirrors[i].n_rows for i in docs), default=0)
         n = max(n, 1)
         k = len(docs)
@@ -563,12 +564,10 @@ class BatchEngine:
             m = self.mirrors[i]
             r = m.n_rows
             if r:
-                row_slot[j, :r] = m.row_slot
-                row_clock[j, :r] = m.row_clock
-                row_end[j, :r] = (
-                    np.asarray(m.row_clock, np.int64)
-                    + np.asarray(m.row_len, np.int64)
-                ).astype(np.int32)
+                c = m._np_cols()
+                row_slot[j, :r] = c["slot"]
+                row_clock[j, :r] = c["clock"]
+                row_end[j, :r] = c["row_end"]
         return row_slot, row_clock, row_end
 
     def state_vectors_batched(self, docs: list[int]) -> list[dict[int, int]]:
