@@ -168,9 +168,9 @@ def bench_b4_broadcast(n_docs: int) -> dict:
         "right_clock": jnp.asarray(pad_col("right_clock", 0, np.int32)),
         "origin_row": jnp.asarray(pad_col("origin_row", NULL, np.int32)),
     }
-    lv_one = np.full((1, 1, 6), NULL, np.int32)
+    lv_one = np.full((1, 1, 8), NULL, np.int32)
     if plan.sched:
-        lv_one = np.full((len(packed), w_pad, 6), NULL, np.int32)
+        lv_one = np.full((len(packed), w_pad, 8), NULL, np.int32)
         for lv, entries in enumerate(packed):
             if entries:
                 lv_one[lv, : len(entries)] = entries

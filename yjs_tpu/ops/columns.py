@@ -42,7 +42,7 @@ from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
 
 NULL = -1  # null id / null row sentinel in every int column
-# sched6 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
+# sched8 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
 NO_LEFT_WRITE = -3  # chain member: placed by its predecessor's succ write
 GATHER_SUCC = -2  # succ: the old successor of `check` (== right when fast)
 
@@ -424,9 +424,9 @@ class StepPlan:
     # delete ranges applied this step (client, clock, len) — the DS section
     # of the step's emitted incremental update
     applied_ds: list[tuple[int, int, int]] = field(default_factory=list)
-    # 6-field bulk schedule (row, left, right, check, succ, seg) with
-    # dependency levels (1-based): see assign_levels
-    sched6: list[tuple[int, int, int, int, int, int]] = field(
+    # 8-field bulk schedule (row, left, right, check, succ, seg, fb_left,
+    # fb_right) with dependency levels (1-based): see assign_levels
+    sched8: list[tuple[int, int, int, int, int, int, int, int]] = field(
         default_factory=list
     )
     levels: list[int] = field(default_factory=list)
@@ -440,19 +440,28 @@ class StepPlan:
         left row determines the origin id and vice versa — so YATA orders
         them by ascending client (reference Item.js case 1, :447-455).  The
         host pre-links each such group into a chain spliced in ONE bulk
-        write; remaining items get one entry each.  Levels then only encode
-        true causal depth: an entry's level exceeds the level of the rows
-        its gap depends on, and no two entries in a level share a write
-        target.
+        write; remaining items get one entry each.
 
-        Each sched6 entry is (row, left, right, check, succ, seg):
+        Chains also extend ACROSS groups: when a group's gap-left is the
+        current tail of an already-emitted chain and its right matches the
+        chain's right (sequential typing: each new run's origin is the last
+        id of the previous run), the group joins that chain at the SAME
+        level — the whole typing session splices in one bulk write instead
+        of one level per run.  This flattens the reference's inherently
+        sequential insertion chains (Item.js fast path :432-434) into O(1)
+        levels for the common editing texture.
+
+        Each sched8 entry is (row, left, right, check, succ, seg, fb_left,
+        fb_right):
         - fast iff rl[check] == right (check==NULL: head test
-          starts[seg]==right)
+          starts[seg]==right); all members of one chain share (check,
+          right), so a chain is fast or deferred as a whole
         - splice: rl[left] = row (left>=0), starts[seg] = row (left==NULL),
           rl[row] = succ, where succ==GATHER_SUCC means the gathered old
           successor of `check`
         - on fast-check failure the item integrates sequentially with
-          (row, check, right, seg) — the original YATA inputs.
+          (row, fb_left, fb_right, seg) — its ORIGINAL YATA gap, which for
+          stitched groups differs from the chain-head's (check, right).
         """
         groups: dict[tuple[int, int, int], list[int]] = {}
         order: list[tuple[int, int, int]] = []
@@ -465,10 +474,13 @@ class StepPlan:
             else:
                 g.append(i)
 
-        self.sched6 = []
+        self.sched8 = []
         self.levels = []
         lev_of_row: dict[int, int] = {}
         used: set[tuple[int, object]] = set()
+        # chain tails open for stitching: tail row -> (entry idx, head
+        # check, head right, level)
+        tails: dict[int, tuple[int, int, int, int]] = {}
         n_levels = 0
         for key in order:
             left, right, seg = key
@@ -476,6 +488,25 @@ class StepPlan:
             members = [self.sched[i][0] for i in idxs]
             if len(members) > 1:
                 members.sort(key=client_of_row)
+            t = tails.get(left) if left != NULL else None
+            if t is not None and t[2] == right and self.sched8[t[0]][5] == seg:
+                # stitch: continue the chain ending at `left` in place
+                idx0, hchk, hr0, lev = t
+                e = self.sched8[idx0]
+                self.sched8[idx0] = e[:4] + (members[0],) + e[5:]
+                for j, row in enumerate(members):
+                    succ = (
+                        members[j + 1] if j + 1 < len(members) else GATHER_SUCC
+                    )
+                    self.sched8.append(
+                        (row, NO_LEFT_WRITE, hr0, hchk, succ, seg, left, right)
+                    )
+                    self.levels.append(lev)
+                    lev_of_row[row] = lev
+                del tails[left]
+                tails[members[-1]] = (len(self.sched8) - 1, hchk, hr0, lev)
+                # n_levels already covers lev: the head chain raised it
+                continue
             base = 1 + max(lev_of_row.get(left, 0), lev_of_row.get(right, 0))
             # write-target key: rl[left] for real lefts, the segment's head
             # slot otherwise (distinct segments' head writes may share a
@@ -488,18 +519,19 @@ class StepPlan:
             for j, row in enumerate(members):
                 entry_left = left if j == 0 else NO_LEFT_WRITE
                 succ = members[j + 1] if j + 1 < len(members) else GATHER_SUCC
-                self.sched6.append((row, entry_left, right, left, succ, seg))
+                self.sched8.append(
+                    (row, entry_left, right, left, succ, seg, left, right)
+                )
                 self.levels.append(lev)
                 lev_of_row[row] = lev
+            tails[members[-1]] = (len(self.sched8) - 1, left, right, lev)
             n_levels = max(n_levels, lev)
         self.n_levels = n_levels
 
-    def packed_levels(self) -> list[list[tuple[int, int, int, int, int, int]]]:
-        """The 6-field schedule grouped level-major ([L, W, 6] device pack)."""
-        out: list[list[tuple[int, int, int, int, int, int]]] = [
-            [] for _ in range(self.n_levels)
-        ]
-        for entry, lev in zip(self.sched6, self.levels):
+    def packed_levels(self):
+        """The 8-field schedule grouped level-major ([L, W, 8] device pack)."""
+        out: list[list[tuple[int, ...]]] = [[] for _ in range(self.n_levels)]
+        for entry, lev in zip(self.sched8, self.levels):
             out[lev - 1].append(entry)
         return out
 

@@ -334,7 +334,7 @@ class BatchEngine:
 
             splits = np.full((b, n_splits, 2), NULL, np.int32)
             sched = np.full((b, n_sched, 4), NULL, np.int32)
-            lv_sched = np.full((b, n_lv, w_lv, 6), NULL, np.int32)
+            lv_sched = np.full((b, n_lv, w_lv, 8), NULL, np.int32)
             dels = np.full((b, n_del), NULL, np.int32)
             statics = {
                 "client_key": np.zeros((b, cap + 1), np.uint32),
@@ -409,14 +409,14 @@ class BatchEngine:
                         self._emit(i, u)
         t_emit = time.perf_counter()
 
-        n_sched_entries = sum(len(p.sched6) for p in plans.values())
+        n_sched_entries = sum(len(p.sched8) for p in plans.values())
         lv_slots = b * n_lv * w_lv
         pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
         metrics.update({
             "n_docs_flushed": sum(
                 1
                 for p in plans.values()
-                if p.sched6 or p.splits or p.delete_rows
+                if p.sched8 or p.splits or p.delete_rows
             ),
             "n_rows_max": max_rows,
             "n_sched_entries": n_sched_entries,

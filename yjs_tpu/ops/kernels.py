@@ -212,8 +212,9 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     indices (duplicate scatter indices serialize on TPU).  The engine
     guarantees >= W spare slots and masks phantom rows at export.
 
-    ``lv_sched`` is the 6-field schedule packed level-major, [L, W, 6]
-    NULL-padded rows of (row, left, right, check, succ, seg); items in one
+    ``lv_sched`` is the 8-field schedule packed level-major, [L, W, 8]
+    NULL-padded rows of (row, left, right, check, succ, seg, fb_left,
+    fb_right); items in one
     dependency level (host-assigned, see StepPlan.assign_levels) have
     distinct splice gaps and already-placed deps, so every fast-path item
     in a level splices in ONE vectorized pass; items sharing a gap are
@@ -254,6 +255,8 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
         chk = lv[:, 3]  # shared gap left (NULL = head gap)
         succ = lv[:, 4]  # next chain member, or GATHER_SUCC = old gap successor
         seg = lv[:, 5]  # segment (root list / map-key chain) of the row
+        fb_l = lv[:, 6]  # the row's ORIGINAL YATA gap, for the deferred
+        fb_r = lv[:, 7]  # fallback (differs from chk/r0 on stitched chains)
         w = k.shape[0]
         mask = k >= 0
         safe_chk = jnp.where(chk >= 0, chk, dummy)
@@ -301,7 +304,7 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
         def defer_body(cs):
             pending, carry = cs
             j = jnp.argmax(pending)
-            carry = integrate_item(carry, k[j], chk[j], r0[j], seg[j])
+            carry = integrate_item(carry, k[j], fb_l[j], fb_r[j], seg[j])
             return pending.at[j].set(False), carry
 
         _, (rl, starts) = lax.while_loop(
@@ -332,7 +335,7 @@ def batch_step(statics, dyn, splits, sched, delete_rows):
 def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     """vmapped level-parallel integration step (the default engine path).
 
-    lv_sched: [B, L, W, 6] level-major sched6 schedule, NULL-padded.
+    lv_sched: [B, L, W, 8] level-major sched8 schedule, NULL-padded.
     scratch_base: [B] i32 per-doc row count (see _doc_step_levels).
     """
     return jax.vmap(_doc_step_levels)(
