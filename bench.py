@@ -313,6 +313,10 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
                 k: round(m.get(k, 0.0), 4)
                 for k in ("t_plan_s", "t_pack_s", "t_dispatch_s")
             },
+            # host transcode (decode + causal schedule + pre-split) per doc
+            "transcode_ms_per_doc": round(
+                m.get("t_plan_s", 0.0) / max(1, n_docs) * 1e3, 3
+            ),
             "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
             "n_demoted": m.get("n_demoted", 0),
         },

@@ -69,6 +69,23 @@ def test_sharded_incremental_concurrent(mesh8):
         assert eng.text(i) == d.get_text("text").to_string()
 
 
+def test_engine_batched_svs_use_sharded_kernel(mesh8):
+    # state_vectors_batched on a meshed engine routes through
+    # sharded_state_vectors (padding the doc subset to the mesh axis)
+    n = 8
+    docs = build_docs(n)
+    eng = BatchEngine(n, mesh=mesh8)
+    for i, d in enumerate(docs):
+        eng.queue_update(i, Y.encode_state_as_update(d))
+    eng.flush()
+    subset = [0, 3, 5]  # not a multiple of the axis size: exercises padding
+    svs = eng.state_vectors_batched(subset)
+    for j, i in enumerate(subset):
+        assert svs[j] == {
+            c: v for c, v in Y.get_state_vector(docs[i].store).items() if v > 0
+        }
+
+
 def test_sharded_state_vector_kernel(mesh8):
     b, n, slots = 8, 16, 4
     rng = np.random.RandomState(0)

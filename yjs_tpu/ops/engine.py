@@ -61,6 +61,16 @@ def _bucket(n: int, minimum: int = 64) -> int:
     return b
 
 
+# target size of one level-axis schedule tile (entries per doc-batch block);
+# big enough that kernel launch overhead amortizes, small enough that the
+# padded [B, block, W, 8] tile stays modest at any log length
+_BLOCK_BUDGET = 1 << 22
+
+
+def _block_levels(n_docs: int, w_lv: int) -> int:
+    return _bucket(max(1, _BLOCK_BUDGET // max(1, n_docs * w_lv)), 1)
+
+
 def _phase(name: str):
     """jax.profiler annotation around one flush phase — visible in any
     active jax.profiler trace (the per-phase tracing SURVEY.md §5 calls
@@ -104,6 +114,9 @@ class BatchEngine:
         self._update_listeners: list = []
         self._metrics_dev: dict | None = None
         self._sharded_step = None
+        # cached sharded state-vector callables keyed by n_slots (jit's
+        # cache is per function identity — rebuilding retraces every call)
+        self._sharded_sv: dict[int, object] = {}
         if mesh is not None:
             doc_axis = mesh.axis_names[0]
             axis_size = mesh.shape[doc_axis]
@@ -369,25 +382,53 @@ class BatchEngine:
         t_pack = time.perf_counter()
         with _phase("dispatch"):
             dyn = (self._right, self._deleted, self._starts)
-            if self._sharded_step is not None:
-                # keep metrics as device scalars: converting here would block
-                # the async dispatch and serialize host transcode with device
-                # compute
-                new_dyn, self._metrics_dev = self._sharded_step(
-                    statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
-                    jnp.asarray(dels), jnp.asarray(scratch_base),
-                )
-            elif os.environ.get("YTPU_KERNEL") == "seq":
-                new_dyn = kernels.batch_step(
+            if os.environ.get("YTPU_KERNEL") == "seq":
+                dyn = kernels.batch_step(
                     statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
                     jnp.asarray(dels),
                 )
             else:
-                new_dyn = kernels.batch_step_levels(
-                    statics, dyn, jnp.asarray(splits), jnp.asarray(lv_sched),
-                    jnp.asarray(dels), jnp.asarray(scratch_base),
+                # blockwise over the level axis (the long-context analogue,
+                # SURVEY.md §5: long update logs are processed as fixed-size
+                # schedule tiles).  Levels are causally ordered and the
+                # device state persists between dispatches, so slicing by
+                # level prefix is exact: splits run only in the first block,
+                # deletes only in the last.  Bounds the padded [B, L, W, 8]
+                # transfer and device buffer no matter how long the log is —
+                # on the single-chip and the sharded (mesh) path alike.
+                block = max(
+                    1,
+                    int(os.environ.get("YTPU_BLOCK_LEVELS", "0"))
+                    or _block_levels(b, w_lv),
                 )
-            self._right, self._deleted, self._starts = new_dyn
+                empty_splits = jnp.full((b, 1, 2), NULL, jnp.int32)
+                empty_dels = jnp.full((b, 1), NULL, jnp.int32)
+                scratch_d = jnp.asarray(scratch_base)
+                self._metrics_dev = None
+                for c0 in range(0, n_lv, block):
+                    c1 = min(n_lv, c0 + block)
+                    args = (
+                        statics,
+                        dyn,
+                        jnp.asarray(splits) if c0 == 0 else empty_splits,
+                        jnp.asarray(lv_sched[:, c0:c1]),
+                        jnp.asarray(dels) if c1 == n_lv else empty_dels,
+                        scratch_d,
+                    )
+                    if self._sharded_step is not None:
+                        # metrics stay device scalars (converting would block
+                        # the async dispatch); accumulate across blocks
+                        dyn, m = self._sharded_step(*args)
+                        self._metrics_dev = (
+                            m
+                            if self._metrics_dev is None
+                            else {
+                                k: self._metrics_dev[k] + m[k] for k in m
+                            }
+                        )
+                    else:
+                        dyn = kernels.batch_step_levels(*args)
+            self._right, self._deleted, self._starts = dyn
         t_dispatch = time.perf_counter()
 
         with _phase("emit"):
@@ -584,12 +625,33 @@ class BatchEngine:
         if dev:
             dev_docs = [i for _, i in dev]
             row_slot, _clock, row_end = self._sync_columns(dev_docs)
-            n_slots = max(len(self.mirrors[i].client_of_slot) for i in dev_docs)
-            sv = np.asarray(
-                kernels.state_vector_kernel(
-                    jnp.asarray(row_slot), jnp.asarray(row_end), max(1, n_slots)
+            n_slots = max(1, max(len(self.mirrors[i].client_of_slot) for i in dev_docs))
+            if self.mesh is not None:
+                # the sharded segment-max path: pad the doc subset to the
+                # mesh axis, compute shard-locally, gather over ICI
+                axis = self.mesh.axis_names[0]
+                size = self.mesh.shape[axis]
+                pad = (-len(dev_docs)) % size
+                if pad:
+                    row_slot = np.pad(
+                        row_slot, ((0, pad), (0, 0)), constant_values=NULL
+                    )
+                    row_end = np.pad(row_end, ((0, pad), (0, 0)))
+                f = self._sharded_sv.get(n_slots)
+                if f is None:
+                    from ..parallel.mesh import sharded_state_vectors
+
+                    f = sharded_state_vectors(self.mesh, n_slots, axis)
+                    self._sharded_sv[n_slots] = f
+                sv = np.asarray(
+                    f(jnp.asarray(row_slot), jnp.asarray(row_end))
                 )
-            )
+            else:
+                sv = np.asarray(
+                    kernels.state_vector_kernel(
+                        jnp.asarray(row_slot), jnp.asarray(row_end), n_slots
+                    )
+                )
             for r, (j, i) in enumerate(dev):
                 m = self.mirrors[i]
                 out[j] = {
