@@ -84,6 +84,8 @@ def test_engine_batched_svs_use_sharded_kernel(mesh8):
         assert svs[j] == {
             c: v for c, v in Y.get_state_vector(docs[i].store).items() if v > 0
         }
+    # the sharded shard_map kernel actually served the request
+    assert eng._sharded_sv
 
 
 def test_sharded_state_vector_kernel(mesh8):

@@ -1731,14 +1731,14 @@ class DocMirror:
                 encoder.write_string(sub)
         self.realized_content(row).write(encoder, offset)
 
-    def origin_rows(self) -> np.ndarray:
-        """For every row, the row *containing* its origin id (NULL if no
-        origin) — the columnar get_item(store, o.origin) of the case-2
+    def origin_rows(self, start: int = 0) -> np.ndarray:
+        """For rows [start:], the row *containing* each origin id (NULL if
+        no origin) — the columnar get_item(store, o.origin) of the case-2
         conflict check (reference src/structs/Item.js:447-470)."""
         n = self.n_rows
-        out = np.full(n, NULL, np.int32)
-        oslot = np.asarray(self.row_origin_slot, np.int32)
-        oclock = np.asarray(self.row_origin_clock, np.int64)
+        out = np.full(n - start, NULL, np.int32)
+        oslot = np.asarray(self.row_origin_slot[start:], np.int32)
+        oclock = np.asarray(self.row_origin_clock[start:], np.int64)
         for s in range(len(self.client_of_slot)):
             mask = oslot == s
             if not mask.any():
@@ -1749,17 +1749,19 @@ class DocMirror:
             out[np.nonzero(mask)[0]] = fr[np.clip(idx, 0, len(fr) - 1)]
         return out
 
-    def static_columns(self) -> dict[str, np.ndarray]:
-        """The immutable device columns for the current table."""
+    def static_columns(self, start: int = 0) -> dict[str, np.ndarray]:
+        """The immutable device columns for rows [start:] — host cost scales
+        with the delta when the caller keeps earlier rows resident."""
         return {
             "client_key": np.asarray(
-                [self.client_of_slot[s] for s in self.row_slot], np.uint32
+                [self.client_of_slot[s] for s in self.row_slot[start:]],
+                np.uint32,
             ),
-            "origin_slot": np.asarray(self.row_origin_slot, np.int32),
-            "origin_clock": np.asarray(self.row_origin_clock, np.int32),
-            "right_slot": np.asarray(self.row_right_slot, np.int32),
-            "right_clock": np.asarray(self.row_right_clock, np.int32),
-            "origin_row": self.origin_rows(),
+            "origin_slot": np.asarray(self.row_origin_slot[start:], np.int32),
+            "origin_clock": np.asarray(self.row_origin_clock[start:], np.int32),
+            "right_slot": np.asarray(self.row_right_slot[start:], np.int32),
+            "right_clock": np.asarray(self.row_right_clock[start:], np.int32),
+            "origin_row": self.origin_rows(start),
         }
 
     def has_pending(self) -> bool:

@@ -71,6 +71,15 @@ def _block_levels(n_docs: int, w_lv: int) -> int:
     return _bucket(max(1, _BLOCK_BUDGET // max(1, n_docs * w_lv)), 1)
 
 
+if HAS_JAX:
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _scatter_statics(statics, d, r, vals):
+        """All six resident-column updates in ONE device dispatch."""
+        return {k: statics[k].at[d, r].set(vals[k]) for k in statics}
+
+
 def _phase(name: str):
     """jax.profiler annotation around one flush phase — visible in any
     active jax.profiler trace (the per-phase tracing SURVEY.md §5 calls
@@ -144,6 +153,11 @@ class BatchEngine:
         self._right = None
         self._deleted = None
         self._starts = None
+        # resident immutable columns, updated by per-flush row scatters —
+        # steady-state flush transfer scales with the DELTA, not with B*cap
+        self._statics: dict | None = None
+        # rows per doc already uploaded and still valid on device
+        self._uploaded_rows = [0] * n_docs
 
     # -- update ingestion ---------------------------------------------------
 
@@ -185,6 +199,7 @@ class BatchEngine:
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
         self._update_log[doc] = []
+        self._uploaded_rows[doc] = 0
         if self._update_listeners:
             # emit the demoting flush's novelty, then live-forward the
             # fallback doc's own update events
@@ -204,6 +219,15 @@ class BatchEngine:
         return fb
 
     # -- device state management -------------------------------------------
+
+    _STATIC_COLS = (
+        ("client_key", 0, jnp.uint32),
+        ("origin_slot", NULL, jnp.int32),
+        ("origin_clock", 0, jnp.int32),
+        ("right_slot", NULL, jnp.int32),
+        ("right_clock", 0, jnp.int32),
+        ("origin_row", NULL, jnp.int32),
+    )
 
     def _ensure_capacity(self, n_rows: int, n_segs: int) -> None:
         cap = _bucket(n_rows)
@@ -229,6 +253,68 @@ class BatchEngine:
         self._right = jnp.asarray(new_right)
         self._deleted = jnp.asarray(new_deleted)
         self._starts = jnp.asarray(new_starts)
+        # grow the resident statics device-side (pad, no host round trip)
+        old_statics = self._statics
+        self._statics = {}
+        for key, fill, dtype in self._STATIC_COLS:
+            if old_statics is not None:
+                self._statics[key] = jnp.pad(
+                    old_statics[key],
+                    ((0, 0), (0, self._cap - old_cap)),
+                    constant_values=fill,
+                )
+            else:
+                self._statics[key] = jnp.full((b, self._cap + 1), fill, dtype)
+
+    def _upload_statics(self, plans) -> None:
+        """Scatter this flush's NEW/changed rows into the resident statics.
+
+        A doc's immutable columns only change by appending rows — except
+        when a pre-split cuts an existing run (origin_row coverage moves to
+        the new fragment) or compaction renumbered the table, which both
+        force a full re-upload of that doc.  One batched scatter per column
+        carries every active doc's delta."""
+        doc_idx: list[np.ndarray] = []
+        row_idx: list[np.ndarray] = []
+        vals: dict[str, list[np.ndarray]] = {k: [] for k, _f, _d in self._STATIC_COLS}
+        for i, p in plans.items():
+            m = self.mirrors[i]
+            n = m.n_rows
+            start = 0 if p.splits else self._uploaded_rows[i]
+            if n <= start:
+                continue
+            cols = m.static_columns(start)
+            doc_idx.append(np.full(n - start, i, np.int32))
+            row_idx.append(np.arange(start, n, dtype=np.int32))
+            for k in vals:
+                vals[k].append(cols[k])
+            self._uploaded_rows[i] = n
+        if not doc_idx:
+            return
+        d = np.concatenate(doc_idx)
+        r = np.concatenate(row_idx)
+        # pad to a power-of-two bucket so the scatter compiles once per
+        # bucket, not once per delta size; padding lanes write the scratch
+        # row (index cap) of doc 0, whose contents are never read
+        total = len(d)
+        padded = _bucket(total, 64)
+        if padded > total:
+            pad = padded - total
+            d = np.concatenate([d, np.zeros(pad, np.int32)])
+            r = np.concatenate(
+                [r, np.full(pad, self._cap, np.int32)]
+            )
+        vpad = {}
+        for k, fill, dtype in self._STATIC_COLS:
+            v = np.concatenate(vals[k])
+            if padded > total:
+                v = np.concatenate(
+                    [v, np.full(padded - total, fill, v.dtype)]
+                )
+            vpad[k] = jnp.asarray(v)
+        self._statics = _scatter_statics(
+            self._statics, jnp.asarray(d), jnp.asarray(r), vpad
+        )
 
     # -- compaction ---------------------------------------------------------
 
@@ -267,6 +353,7 @@ class BatchEngine:
             new_deleted[j, :n_new] = d
             new_starts[j, : len(h)] = h
             self._rows_at_compact[i] = n_new
+            self._uploaded_rows[i] = 0  # renumbered: statics re-upload
             self.last_compaction.append(
                 {"doc": i, "rows_before": old_n, "rows_after": n_new}
             )
@@ -289,6 +376,8 @@ class BatchEngine:
             for i, m in enumerate(self.mirrors):
                 if i in self.fallback:
                     continue
+                if not m._incoming and not m.has_pending():
+                    continue  # idle doc: nothing to plan, upload, or emit
                 if emitting:
                     pre_svs[i] = m.state_vector()
                 try:
@@ -349,21 +438,7 @@ class BatchEngine:
             sched = np.full((b, n_sched, 4), NULL, np.int32)
             lv_sched = np.full((b, n_lv, w_lv, 8), NULL, np.int32)
             dels = np.full((b, n_del), NULL, np.int32)
-            statics = {
-                "client_key": np.zeros((b, cap + 1), np.uint32),
-                "origin_slot": np.full((b, cap + 1), NULL, np.int32),
-                "origin_clock": np.zeros((b, cap + 1), np.int32),
-                "right_slot": np.full((b, cap + 1), NULL, np.int32),
-                "right_clock": np.zeros((b, cap + 1), np.int32),
-                "origin_row": np.full((b, cap + 1), NULL, np.int32),
-            }
             for i, p in plans.items():
-                m = self.mirrors[i]
-                n = m.n_rows
-                if n:
-                    cols = m.static_columns()
-                    for k in statics:
-                        statics[k][i, :n] = cols[k]
                 if p.splits:
                     splits[i, : len(p.splits)] = p.splits
                 if p.sched:
@@ -374,15 +449,20 @@ class BatchEngine:
                 if p.delete_rows:
                     dels[i, : len(p.delete_rows)] = p.delete_rows
 
-            scratch_base = np.zeros((b,), np.int32)
-            for i, p in plans.items():
-                scratch_base[i] = p.n_rows
+            # EVERY doc needs its true row count here — masked scatter lanes
+            # land at scratch_base+lane even for docs with no work this
+            # flush, and must hit the padding region, not live rows
+            scratch_base = np.asarray(
+                [m.n_rows for m in self.mirrors], np.int32
+            )
 
-            statics = {k: jnp.asarray(v) for k, v in statics.items()}
+            self._upload_statics(plans)
+            statics = self._statics
         t_pack = time.perf_counter()
         with _phase("dispatch"):
             dyn = (self._right, self._deleted, self._starts)
             if os.environ.get("YTPU_KERNEL") == "seq":
+                self._metrics_dev = None  # no sharded counters this flush
                 dyn = kernels.batch_step(
                     statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
                     jnp.asarray(dels),
@@ -401,8 +481,10 @@ class BatchEngine:
                     int(os.environ.get("YTPU_BLOCK_LEVELS", "0"))
                     or _block_levels(b, w_lv),
                 )
-                empty_splits = jnp.full((b, 1, 2), NULL, jnp.int32)
-                empty_dels = jnp.full((b, 1), NULL, jnp.int32)
+                empty_splits = empty_dels = None
+                if n_lv > block:  # multi-block: cache the no-op inputs
+                    empty_splits = jnp.full((b, 1, 2), NULL, jnp.int32)
+                    empty_dels = jnp.full((b, 1), NULL, jnp.int32)
                 scratch_d = jnp.asarray(scratch_base)
                 self._metrics_dev = None
                 for c0 in range(0, n_lv, block):
@@ -625,7 +707,11 @@ class BatchEngine:
         if dev:
             dev_docs = [i for _, i in dev]
             row_slot, _clock, row_end = self._sync_columns(dev_docs)
-            n_slots = max(1, max(len(self.mirrors[i].client_of_slot) for i in dev_docs))
+            # bucket n_slots so client-count growth compiles O(log) variants
+            n_slots = _bucket(
+                max(1, max(len(self.mirrors[i].client_of_slot) for i in dev_docs)),
+                4,
+            )
             if self.mesh is not None:
                 # the sharded segment-max path: pad the doc subset to the
                 # mesh axis, compute shard-locally, gather over ICI
