@@ -220,7 +220,7 @@ def bench_b4_broadcast(n_docs: int) -> dict:
 
     # ---- convergence check: doc 0's visible text vs the reference --------
     right, deleted, start = out
-    text_seg = mirror.segments[("text", None)]
+    text_seg = mirror.segments[("text", None, NULL)]
     valid = np.zeros(cap + 1, bool)
     valid[:n] = np.asarray(mirror.row_seg, np.int32) == text_seg
     d = np.asarray(kernels.list_ranks(right[:1], jnp.asarray(valid)[None]))[0]

@@ -55,7 +55,7 @@ class TestProvider:
         prov = TpuProvider(2)
         d = Y.Doc(gc=False)
         d.client_id = 5
-        d.get_map("meta").set("nested", Y.YMap())  # ContentType -> CPU path
+        d.get_map("meta").set("sub", Y.Doc(guid="child"))  # ContentDoc
         d.get_text("text").insert(0, "t")
         prov.receive_update("mixed", Y.encode_state_as_update(d))
         prov.flush()
@@ -63,9 +63,21 @@ class TestProvider:
         assert prov.text("mixed") == "t"
         # the demotion is visible with its reason, not silent
         assert prov.demotions == [
-            {"guid": "mixed", "reason": "content ref 7"}
+            {"guid": "mixed", "reason": "subdocument (content ref 9)"}
         ]
         assert prov.metrics["n_demoted"] == 1
+
+    def test_nested_room_stays_on_device(self):
+        prov = TpuProvider(2)
+        d = Y.Doc(gc=False)
+        d.client_id = 5
+        inner = Y.YMap()
+        d.get_map("meta").set("nested", inner)
+        inner.set("x", 1)
+        prov.receive_update("room", Y.encode_state_as_update(d))
+        prov.flush()
+        assert prov.n_fallback_docs == 0
+        assert prov.engine.map_json(0, "meta") == {"nested": {"x": 1}}
 
     def test_flush_metrics_phases_and_occupancy(self):
         prov = TpuProvider(4)
@@ -212,8 +224,8 @@ class TestUpdateEmission:
         d.get_text("text").insert(0, "pre ")
         prov.receive_update("r", Y.encode_state_as_update(d))
         prov.flush()
-        # demote mid-stream with a nested type, then keep editing
-        d.get_map("m").set("nested", Y.YMap())
+        # demote mid-stream with a subdocument, then keep editing
+        d.get_map("m").set("sub", Y.Doc(guid="child"))
         sv = Y.encode_state_vector(d)
         prov.receive_update("r", Y.encode_state_as_update(d, None))
         prov.flush()

@@ -250,16 +250,198 @@ class TestFallback:
         assert eng.text(0, "notes") == "n0"
         assert eng.map_json(0, "m") == {"k": 1}
 
-    def test_nested_type_demotes_to_cpu(self):
+    def test_subdoc_demotes_to_cpu(self):
         doc = make_doc(9)
-        inner = Y.YMap()
-        doc.get_map("m").set("nested", inner)  # ContentType -> CPU path
+        doc.get_map("m").set("sub", Y.Doc(guid="child"))  # ContentDoc
         doc.get_text("text").insert(0, "hi")
         eng = BatchEngine(1)
         eng.queue_update(0, Y.encode_state_as_update(doc))
         eng.flush()
         assert 0 in eng.fallback
+        assert eng.demotions[0]["reason"] == "subdocument (content ref 9)"
         assert eng.text(0) == "hi"
+
+
+class TestNestedTypes:
+    """Nested shared types integrate on device as parent-row-keyed segments
+    (reference ContentType.js); only subdocuments fall back."""
+
+    def test_nested_map_array_text_stay_on_device(self):
+        a = make_doc(5)
+        m = a.get_map("root")
+        inner = Y.YMap()
+        m.set("inner", inner)
+        inner.set("k", 42)
+        arr = a.get_array("arr")
+        nt = Y.YText()
+        arr.insert(0, ["plain", nt])
+        nt.insert(0, "nested text")
+        nt.insert(6, "🙂")
+        eng = BatchEngine(1)
+        eng.queue_update(0, Y.encode_state_as_update(a))
+        eng.flush()
+        assert not eng.fallback
+        assert eng.map_json(0, "root") == a.get_map("root").to_json()
+        assert eng.to_json(0, "arr") == a.get_array("arr").to_json()
+        # the mirror's wire export reconstructs the nested state
+        d = Y.Doc(gc=False)
+        Y.apply_update(d, eng.encode_state_as_update(0))
+        assert d.get_map("root").to_json() == a.get_map("root").to_json()
+        assert d.get_array("arr").to_json() == a.get_array("arr").to_json()
+
+    def test_parent_arrives_after_children(self):
+        # children reference the type item causally: delivering them first
+        # must park them in pending, not corrupt state
+        a = make_doc(6)
+        sv0 = Y.encode_state_vector(a)
+        nt = Y.YText()
+        a.get_map("root").set("t", nt)
+        u_parent = Y.encode_state_as_update(a, sv0)
+        sv1 = Y.encode_state_vector(a)
+        nt.insert(0, "abc")
+        u_children = Y.encode_state_as_update(a, sv1)
+        eng = BatchEngine(1)
+        eng.queue_update(0, u_children)
+        eng.flush()
+        assert eng.has_pending(0)
+        eng.queue_update(0, u_parent)
+        eng.flush()
+        assert not eng.has_pending(0)
+        assert eng.map_json(0, "root") == {"t": "abc"}
+
+    def test_deleting_type_deletes_subtree(self):
+        a = make_doc(7)
+        arr = a.get_array("arr")
+        nested = Y.YArray()
+        arr.insert(0, [nested, "tail"])
+        nested.insert(0, [1, 2, 3])
+        eng = BatchEngine(1)
+        eng.queue_update(0, Y.encode_state_as_update(a))
+        eng.flush()
+        assert eng.to_json(0, "arr") == [[1, 2, 3], "tail"]
+        sv = Y.encode_state_vector(a)
+        arr.delete(0, 1)  # deletes the nested type + its subtree
+        eng.queue_update(0, Y.encode_state_as_update(a, sv))
+        eng.flush()
+        assert eng.to_json(0, "arr") == a.get_array("arr").to_json() == ["tail"]
+        d = Y.Doc(gc=False)
+        Y.apply_update(d, eng.encode_state_as_update(0))
+        assert d.get_array("arr").to_json() == ["tail"]
+
+    def test_gc_compaction_preserves_nested_parent_rows(self):
+        # a deleted nested type row must survive GC compaction un-merged:
+        # its children's wire parent id is that row's identity
+        a = make_doc(8)
+        arr = a.get_array("arr")
+        arr.insert(0, ["s0", "s1", "s2"])
+        nested = Y.YMap()
+        arr.insert(3, [nested])
+        nested.set("k", 1)
+        arr.insert(4, ["t0", "t1", "t2"])
+        eng = BatchEngine(1, gc=True, compact_min_rows=4)
+        eng.queue_update(0, Y.encode_state_as_update(a))
+        eng.flush()
+        sv = Y.encode_state_vector(a)
+        arr.delete(0, 7)  # everything, nested type included
+        eng.queue_update(0, Y.encode_state_as_update(a, sv))
+        eng.flush()
+        # append until compaction triggers with the tombstoned type inside
+        t = a.get_text("text")
+        for i in range(12):
+            sv = Y.encode_state_vector(a)
+            t.insert(len(t.to_string()), f"w{i} ")
+            eng.queue_update(0, Y.encode_state_as_update(a, sv))
+            eng.flush()
+        assert eng.last_compaction, "compaction should have run"
+        # exports still work and round-trip
+        assert eng.to_json(0, "arr") == a.get_array("arr").to_json() == []
+        d = Y.Doc(gc=False)
+        Y.apply_update(d, eng.encode_state_as_update(0))
+        assert d.get_array("arr").to_json() == []
+        assert d.get_text("text").to_string() == t.to_string()
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_nested_ops(self, seed):
+        gen = random.Random(5000 + seed)
+        n_clients = 3
+        docs = [make_doc(i + 1) for i in range(n_clients)]
+        upds = [collect_updates(d) for d in docs]
+        # everyone starts from a shared nested skeleton
+        nt = Y.YText()
+        na = Y.YArray()
+        docs[0].get_map("root").set("text", nt)
+        docs[0].get_map("root").set("list", na)
+        for d in docs[1:]:
+            Y.apply_update(d, Y.encode_state_as_update(docs[0]))
+        for _ in range(40):
+            i = gen.randrange(n_clients)
+            d = docs[i]
+            op = gen.random()
+            root = d.get_map("root")
+            if op < 0.35:
+                t = root.get("text")
+                if t is not None:
+                    ln = len(t.to_string())
+                    if gen.random() < 0.7 or ln == 0:
+                        t.insert(gen.randint(0, ln), gen.choice(["x", "yz "]))
+                    else:
+                        pos = gen.randrange(ln)
+                        t.delete(pos, min(gen.randint(1, 2), ln - pos))
+            elif op < 0.6:
+                arr = root.get("list")
+                if arr is not None:
+                    if gen.random() < 0.7 or len(arr.to_json()) == 0:
+                        arr.insert(
+                            gen.randint(0, len(arr.to_json())),
+                            [gen.randrange(100)],
+                        )
+                    else:
+                        arr.delete(gen.randrange(len(arr.to_json())), 1)
+            elif op < 0.8:
+                root.set(gen.choice("abc"), gen.randrange(100))
+            else:
+                inner = Y.YMap()
+                root.set(gen.choice("mn"), inner)
+            if gen.random() < 0.3:
+                src, dst = gen.randrange(n_clients), gen.randrange(n_clients)
+                for u in upds[src]:
+                    Y.apply_update(docs[dst], u)
+        all_updates = [u for us in upds for u in us]
+        gen.shuffle(all_updates)
+        for d in docs:
+            for u in all_updates:
+                Y.apply_update(d, u)
+        eng = replay_into_engine(all_updates)
+        assert not eng.fallback, eng.demotions
+        ref = docs[0]
+        for other in docs[1:]:
+            assert other.get_map("root").to_json() == ref.get_map("root").to_json()
+        assert eng.map_json(0, "root") == ref.get_map("root").to_json()
+        # wire export round-trips the full nested state
+        d2 = Y.Doc(gc=False)
+        Y.apply_update(d2, eng.encode_state_as_update(0))
+        assert d2.get_map("root").to_json() == ref.get_map("root").to_json()
+
+    def test_concurrent_nested_edits_converge(self):
+        a, b = make_doc(1), make_doc(2)
+        nt = Y.YText()
+        a.get_map("root").set("doc", nt)
+        Y.apply_update(b, Y.encode_state_as_update(a))
+        # concurrent edits in the nested text
+        a.get_map("root").get("doc").insert(0, "AA")
+        b.get_map("root").get("doc").insert(0, "BB")
+        ua, ub = Y.encode_state_as_update(a), Y.encode_state_as_update(b)
+        Y.apply_update(a, ub)
+        Y.apply_update(b, ua)
+        assert (
+            a.get_map("root").to_json() == b.get_map("root").to_json()
+        )
+        eng = BatchEngine(1)
+        eng.queue_update(0, ub)
+        eng.queue_update(0, ua)
+        eng.flush()
+        assert not eng.fallback
+        assert eng.map_json(0, "root") == a.get_map("root").to_json()
 
 
 class TestUpdateLogCompaction:
@@ -281,7 +463,7 @@ class TestUpdateLogCompaction:
         assert len(eng._update_log[0]) <= 6
         assert_engine_matches(eng, doc)
         # demotion after compaction replays the snapshot + tail correctly
-        doc.get_map("m").set("nested", Y.YMap())  # unsupported -> demote
+        doc.get_map("m").set("sub", Y.Doc(guid="kid"))  # unsupported -> demote
         t.insert(0, "head ")
         eng.queue_update(0, Y.encode_state_as_update(doc, sv))
         eng.flush()

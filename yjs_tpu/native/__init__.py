@@ -63,7 +63,7 @@ def load():
     lib.ytpu_encode_v1.argtypes = (
         [ctypes.POINTER(u8p), u64p, ctypes.c_uint64]      # bufs
         + [i64p] * 3 + [ctypes.c_uint64]                  # row groups
-        + [i64p] * 16                                     # row columns
+        + [i64p] * 18                                     # row columns
         + [u8p, ctypes.c_uint64]                          # strings blob
         + [i64p] * 3 + [ctypes.c_uint64] + [i64p] * 2     # ds groups
         + [u8p, ctypes.c_uint64]                          # out
@@ -106,6 +106,7 @@ def encode_v1_update(
         "clock", "length", "offset",
         "origin_client", "origin_clock", "right_client", "right_clock",
         "content_ref", "name_ofs", "name_len", "sub_ofs", "sub_len",
+        "parent_client", "parent_clock",
         "src_kind", "src_buf", "src_ofs", "src_end",
     )
     # materialize every array first: the ctypes pointers do not keep their
@@ -125,11 +126,11 @@ def encode_v1_update(
         n_bufs,
         i64ptr(keep[0]), i64ptr(keep[1]), i64ptr(keep[2]),
         len(keep[0]),
-        *(i64ptr(a) for a in keep[3:19]),
+        *(i64ptr(a) for a in keep[3:21]),
         strings_a.ctypes.data_as(u8p), len(strings),
-        i64ptr(keep[19]), i64ptr(keep[20]), i64ptr(keep[21]),
-        len(keep[19]),
-        i64ptr(keep[22]), i64ptr(keep[23]),
+        i64ptr(keep[21]), i64ptr(keep[22]), i64ptr(keep[23]),
+        len(keep[21]),
+        i64ptr(keep[24]), i64ptr(keep[25]),
         out.ctypes.data_as(u8p), out_cap,
     )
     if rc < 0:

@@ -646,6 +646,7 @@ int64_t ytpu_encode_v1(
     const int64_t* content_ref,
     const int64_t* name_ofs, const int64_t* name_len,
     const int64_t* sub_ofs, const int64_t* sub_len,
+    const int64_t* parent_client, const int64_t* parent_clock,
     const int64_t* src_kind, const int64_t* src_buf,
     const int64_t* src_ofs, const int64_t* src_end,
     const uint8_t* strings, uint64_t strings_len,
@@ -688,11 +689,18 @@ int64_t ytpu_encode_v1(
         w.varuint((uint64_t)right_clock[r]);
       }
       if (!has_o && !has_r) {
-        w.varuint(1);  // parent_info: root-type key (Item.js:640-652)
-        if (name_ofs[r] < 0 || (uint64_t)(name_ofs[r] + name_len[r]) > strings_len)
+        if (name_ofs[r] >= 0) {
+          w.varuint(1);  // parent_info: root-type key (Item.js:640-652)
+          if ((uint64_t)(name_ofs[r] + name_len[r]) > strings_len) return -3;
+          w.varuint((uint64_t)name_len[r]);
+          w.bytes(strings + name_ofs[r], (uint64_t)name_len[r]);
+        } else if (parent_client[r] >= 0) {
+          w.varuint(0);  // parent is the nested type item's id (Item.js:644)
+          w.varuint((uint64_t)parent_client[r]);
+          w.varuint((uint64_t)parent_clock[r]);
+        } else {
           return -3;
-        w.varuint((uint64_t)name_len[r]);
-        w.bytes(strings + name_ofs[r], (uint64_t)name_len[r]);
+        }
         if (has_sub) {
           if ((uint64_t)(sub_ofs[r] + sub_len[r]) > strings_len) return -3;
           w.varuint((uint64_t)sub_len[r]);

@@ -40,6 +40,7 @@ from ..core import read_item_content
 from ..lib0 import decoding
 from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
+from ..native import SRC_DELETED, SRC_FRAMED, SRC_NONE, SRC_SPILL, SRC_UTF8
 
 NULL = -1  # null id / null row sentinel in every int column
 # sched8 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
@@ -565,12 +566,22 @@ class DocMirror:
         # client <-> dense slot mapping
         self.client_of_slot: list[int] = []
         self.slot_of_client: dict[int, int] = {}
-        # segment registry: (root name, parent_sub or None) -> seg id
-        self.segments: dict[tuple[str, str | None], int] = {}
-        self.seg_info: list[tuple[str, str | None]] = []
+        # segment registry: (root name or None, parent_sub or None,
+        # parent type-item row or NULL) -> seg id.  Root segments carry the
+        # share-map name; NESTED segments (shared types inside ContentType
+        # items, reference ContentType.js) are keyed by the row holding the
+        # type item — the same YATA kernel integrates either kind
+        self.segments: dict[tuple[str | None, str | None, int], int] = {}
+        self.seg_info: list[tuple[str | None, str | None, int]] = []
+        # rows fully deleted as known host-side (delete resolution + LWW);
+        # type rows are length-1 so this is exact for the parent checks
+        self._host_deleted_rows: set[int] = set()
         # per-map-segment host chain: rows in YATA order (tiny lists — one
         # entry per concurrent writer of one key)
         self.map_chain: dict[int, list[int]] = {}
+        # reverse indexes for the recursive type-delete rule
+        self._segs_of_parent: dict[int, list[int]] = {}
+        self._rows_of_seg: dict[int, list[int]] = {}
         # rows already LWW-deleted (dedup for DS bookkeeping)
         self._lww_deleted: set[int] = set()
         # per-row columns (python lists; converted to numpy at flush)
@@ -670,16 +681,24 @@ class DocMirror:
             self._bufs.append(b)
         return j
 
-    def seg(self, name: str, sub: str | None = None) -> int:
-        key = (name, sub)
+    def seg(
+        self, name: str | None, sub: str | None = None, parent_row: int = NULL
+    ) -> int:
+        key = (name, sub, parent_row)
         s = self.segments.get(key)
         if s is None:
             s = len(self.seg_info)
             self.segments[key] = s
             self.seg_info.append(key)
-            no, nl = self._intern(name)
-            self.seg_name_ofs.append(no)
-            self.seg_name_len.append(nl)
+            if parent_row != NULL:
+                self._segs_of_parent.setdefault(parent_row, []).append(s)
+            if name is None:
+                self.seg_name_ofs.append(NULL)
+                self.seg_name_len.append(0)
+            else:
+                no, nl = self._intern(name)
+                self.seg_name_ofs.append(no)
+                self.seg_name_len.append(nl)
             if sub is None:
                 self.seg_sub_ofs.append(NULL)
                 self.seg_sub_len.append(0)
@@ -723,9 +742,11 @@ class DocMirror:
         self.row_content.append(content)
         self.row_content_ref.append(content_ref)
         self.row_seg.append(NULL if is_gc else seg)
+        # membership index only for NESTED segments (the recursive
+        # type-delete rule's sole consumer) — not for every root row
+        if not is_gc and seg != NULL and self.seg_info[seg][2] != NULL:
+            self._rows_of_seg.setdefault(seg, []).append(row)
         # content source for the native encoder
-        from ..native import SRC_DELETED, SRC_FRAMED, SRC_NONE, SRC_SPILL, SRC_UTF8
-
         if is_gc:
             kind, sb, so, se = SRC_NONE, NULL, NULL, NULL
         elif content_ref == 1:
@@ -804,8 +825,6 @@ class DocMirror:
         right_content = self.realized_content(row).splice(offset)
         # the row's content is now a realized, truncated object: its lazy
         # byte range no longer matches — the encoder must re-frame it
-        from ..native import SRC_SPILL
-
         self.row_src_kind[row] = SRC_SPILL
         self._gen += 1
         seg = self.row_seg[row]
@@ -822,6 +841,8 @@ class DocMirror:
         )
         self.row_len[row] = offset
         plan.splits.append((row, new_row))
+        if row in self._host_deleted_rows:
+            self._host_deleted_rows.add(new_row)
         if seg != NULL and self.seg_is_map(seg):
             # fragments of a map-chain entry sit adjacent in its chain
             chain = self.map_chain[seg]
@@ -844,10 +865,8 @@ class DocMirror:
     def _check_supported(self, ref: ItemRef) -> None:
         if ref.is_gc:
             return
-        if ref.parent_id is not None:
-            raise UnsupportedUpdate("nested type parent")
-        if ref.content_ref in (7, 9):  # ContentType / ContentDoc
-            raise UnsupportedUpdate(f"content ref {ref.content_ref}")
+        if ref.content_ref == 9:  # ContentDoc: independent doc lifecycle
+            raise UnsupportedUpdate("subdocument (content ref 9)")
 
     # -- map-chain host bookkeeping ----------------------------------------
 
@@ -908,6 +927,28 @@ class DocMirror:
     def _row_client(self, row: int) -> int:
         return self.client_of_slot[self.row_slot[row]]
 
+    def _delete_row(self, row: int, plan: StepPlan) -> None:
+        """Mark one (pre-split, fully covered) row deleted with all host
+        bookkeeping, recursing into the subtree when the row holds a type
+        item (reference ContentType.delete, ContentType.js:106-129)."""
+        if row in self._host_deleted_rows or self.row_is_gc[row]:
+            return
+        self._host_deleted_rows.add(row)
+        plan.delete_rows.append(row)
+        self._note_deleted(
+            self.row_slot[row], self.row_clock[row], self.row_len[row]
+        )
+        plan.applied_ds.append(
+            (self._row_client(row), self.row_clock[row], self.row_len[row])
+        )
+        sg = self.row_seg[row]
+        if sg != NULL and self.seg_is_map(sg):
+            self._lww_deleted.add(row)
+        if self.row_content_ref[row] == 7:
+            for cs in self._segs_of_parent.get(row, ()):
+                for child in list(self._rows_of_seg.get(cs, ())):
+                    self._delete_row(child, plan)
+
     def _lww_pass(self, segs: set[int], plan: StepPlan) -> None:
         """Delete every map-chain entry except the final tail (the
         order-independent net effect of reference Item.js:497-507 +
@@ -919,14 +960,7 @@ class DocMirror:
             tail = chain[-1]
             for r in chain:
                 if r != tail and r not in self._lww_deleted:
-                    self._lww_deleted.add(r)
-                    plan.delete_rows.append(r)
-                    self._note_deleted(
-                        self.row_slot[r], self.row_clock[r], self.row_len[r]
-                    )
-                    plan.applied_ds.append(
-                        (self._row_client(r), self.row_clock[r], self.row_len[r])
-                    )
+                    self._delete_row(r, plan)
 
     # -- the flush pipeline -------------------------------------------------
 
@@ -983,7 +1017,13 @@ class DocMirror:
                         q.pop(0)  # fully known: dedupe
                         progress = True
                         continue
-                    if not (dep_ok(ref.origin, client) and dep_ok(ref.right_origin, client)):
+                    if not (
+                        dep_ok(ref.origin, client)
+                        and dep_ok(ref.right_origin, client)
+                        and dep_ok(ref.parent_id, client)
+                    ):
+                        # the nested-parent type item is a causal dep too
+                        # (reference Item.getMissing, Item.js:354-397)
                         break
                     if ref.clock < st:
                         ref.trim_left(st - ref.clock)
@@ -1097,12 +1137,26 @@ class DocMirror:
                 right_row = self.frag_row[rslot][fi]
                 if self.row_is_gc[right_row]:
                     degrade = True
+            parent_row = NULL
+            if not degrade and ref.parent_id is not None:
+                pslot = self.slot(ref.parent_id[0])
+                fi = self._frag_containing(pslot, ref.parent_id[1])
+                if fi is None:
+                    raise AssertionError("scheduled ref with unresolved parent")
+                parent_row = self.frag_row[pslot][fi]
+                if (
+                    self.row_is_gc[parent_row]
+                    or self.row_content_ref[parent_row] != 7
+                ):
+                    degrade = True  # parent type was GC'd (Item.js:380-395)
             if degrade:
                 self._add_row(slot, ref.clock, ref.length, None, None, True, None)
                 continue
             # segment: explicit parent, else copied from the neighbour the
             # wire omitted it for (reference encoding.js canCopyParentInfo)
-            if ref.parent_name is not None:
+            if parent_row != NULL:
+                seg = self.seg(None, ref.parent_sub, parent_row)
+            elif ref.parent_name is not None:
                 seg = self.seg(ref.parent_name, ref.parent_sub)
             elif left_row != NULL:
                 seg = self.row_seg[left_row]
@@ -1118,6 +1172,11 @@ class DocMirror:
             if self.seg_is_map(seg):
                 self._chain_insert(seg, row, left_row, right_row)
                 touched_map_segs.add(seg)
+            # an item integrated into a deleted parent is deleted with it
+            # (reference Item.js:500-505)
+            pr = self.seg_info[seg][2]
+            if pr != NULL and pr in self._host_deleted_rows:
+                self._delete_row(row, plan)
             if ref.content_ref == 1:  # ContentDeleted
                 applicable.append((ref.client, ref.clock, ref.length))
 
@@ -1133,17 +1192,11 @@ class DocMirror:
             end = clock + ln
             while i < len(fc) and fc[i] < end:
                 row = fr[i]
-                if fc[i] >= clock and not self.row_is_gc[row]:
-                    plan.delete_rows.append(row)
-                    sg = self.row_seg[row]
-                    if sg != NULL and self.seg_is_map(sg):
-                        # host twin of the deleted bit for map entries so
-                        # map exports need no device readback
-                        self._lww_deleted.add(row)
+                if fc[i] >= clock:
+                    self._delete_row(row, plan)
                 i += 1
             self._note_deleted(slot, clock, ln)
 
-        plan.applied_ds.extend(applicable)
         self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
         plan.assign_levels(self._row_client)
@@ -1188,8 +1241,6 @@ class DocMirror:
                 r = int(right_link[r])
             order_of_seg[seg] = out
 
-        from ..native import SRC_DELETED, SRC_SPILL
-
         # GC pass: deleted content -> tombstone (payload freed)
         if gc:
             for row in range(n):
@@ -1214,6 +1265,11 @@ class DocMirror:
             if bool(deleted[a]) != bool(deleted[b]):
                 return False
             if self.row_is_gc[a] != self.row_is_gc[b]:
+                return False
+            if b in self._segs_of_parent or a in self._segs_of_parent:
+                # a nested segment's parent row must keep its identity —
+                # absorbing it would orphan its children's wire parent id
+                # (even after the GC pass tombstones the type's content)
                 return False
             if self.row_is_gc[a]:
                 return True  # GC runs merge on contiguity alone (GC.js:24-27)
@@ -1329,6 +1385,28 @@ class DocMirror:
         self._lww_deleted = {
             int(new_of_old[r]) for r in self._lww_deleted if new_of_old[r] != NULL
         }
+        self._host_deleted_rows = {
+            int(new_of_old[r])
+            for r in self._host_deleted_rows
+            if new_of_old[r] != NULL
+        }
+        # nested-segment bookkeeping: parent rows renumber; type rows are
+        # never absorbed (ContentType does not merge), so parents survive
+        self._rows_of_seg = {
+            seg: [int(new_of_old[r]) for r in rows if new_of_old[r] != NULL]
+            for seg, rows in self._rows_of_seg.items()
+        }
+        remap_parent = (
+            lambda p: p if p == NULL else int(new_of_old[p])
+        )
+        self.seg_info = [
+            (name, sub, remap_parent(p)) for name, sub, p in self.seg_info
+        ]
+        self.segments = {key: s for s, key in enumerate(self.seg_info)}
+        self._segs_of_parent = {}
+        for s, (_n, _s2, p) in enumerate(self.seg_info):
+            if p != NULL:
+                self._segs_of_parent.setdefault(p, []).append(s)
         # compact the host DS ranges too (sort + merge, DeleteSet.js:113-135)
         for slot, ranges in self.ds.items():
             ranges.sort()
@@ -1346,8 +1424,8 @@ class DocMirror:
         tail's last content element (reference typeMapGet,
         src/types/AbstractType.js:839-845)."""
         out = {}
-        for (n, sub), seg in self.segments.items():
-            if n != name or sub is None:
+        for (n, sub, p), seg in self.segments.items():
+            if n != name or sub is None or p != NULL:
                 continue
             chain = self.map_chain.get(seg)
             if not chain:
@@ -1372,17 +1450,33 @@ class DocMirror:
         write_state_vector(encoder, self.state_vector())
         return encoder.to_bytes()
 
+    @staticmethod
+    def _union_ranges(ranges) -> list[tuple[int, int]]:
+        """Sorted union of (clock, len) ranges.  The mirror's bookkeeping
+        may note overlapping coverage (per-row deletes + remote DS ranges);
+        the wire DS must be disjoint — the reference's sortAndMergeDeleteSet
+        only coalesces exactly-touching ranges because its inputs are
+        disjoint by construction (DeleteSet.js:113-135)."""
+        out: list[tuple[int, int]] = []
+        for clock, ln in sorted(ranges):
+            if out and clock <= out[-1][0] + out[-1][1]:
+                last_c, last_l = out[-1]
+                out[-1] = (last_c, max(last_l, clock + ln - last_c))
+            else:
+                out.append((clock, ln))
+        return out
+
     def delete_set(self):
         """The doc's derived DeleteSet (reference
         createDeleteSetFromStructStore, DeleteSet.js:185-210)."""
-        from ..core import DeleteItem, DeleteSet, sort_and_merge_delete_set
+        from ..core import DeleteItem, DeleteSet
 
         ds = DeleteSet()
         for slot, ranges in self.ds.items():
             ds.clients[self.client_of_slot[slot]] = [
-                DeleteItem(clock, ln) for clock, ln in ranges
+                DeleteItem(clock, ln)
+                for clock, ln in self._union_ranges(ranges)
             ]
-        sort_and_merge_delete_set(ds)
         return ds
 
     def encode_state_as_update(self, target_sv: dict[int, int] | None = None,
@@ -1469,12 +1563,16 @@ class DocMirror:
         if ds_ranges is None:
             ds = self.delete_set()
         else:
-            from ..core import DeleteItem, DeleteSet, sort_and_merge_delete_set
+            from ..core import DeleteItem, DeleteSet
 
-            ds = DeleteSet()
+            by_client: dict[int, list[tuple[int, int]]] = {}
             for client, clock, ln in ds_ranges:
-                ds.clients.setdefault(client, []).append(DeleteItem(clock, ln))
-            sort_and_merge_delete_set(ds)
+                by_client.setdefault(client, []).append((clock, ln))
+            ds = DeleteSet()
+            for client, ranges in by_client.items():
+                ds.clients[client] = [
+                    DeleteItem(c, ln) for c, ln in self._union_ranges(ranges)
+                ]
         write_delete_set(encoder, ds)
         return encoder.to_bytes()
 
@@ -1515,6 +1613,11 @@ class DocMirror:
             "sub_ofs": seg_gather(self.seg_sub_ofs, NULL),
             "sub_len": seg_gather(self.seg_sub_len, 0),
         }
+        # nested-segment parents: each row's parent type item id (NULL root)
+        p_row = seg_gather([p for _n, _s, p in self.seg_info], NULL)
+        safe_p = np.clip(p_row, 0, None)
+        c["parent_client"] = np.where(p_row >= 0, c["client"][safe_p], NULL)
+        c["parent_clock"] = np.where(p_row >= 0, c["clock"][safe_p], 0)
         c["row_end"] = c["clock"] + c["length"]
         # write order: client descending, clock ascending (encoding.js:112)
         c["order"] = np.lexsort((c["clock"], -c["client"]))
@@ -1531,14 +1634,7 @@ class DocMirror:
         realized or partially-written non-string contents are pre-framed
         into a spill buffer by the Python encoder."""
         from ..coding import UpdateEncoderV1
-        from ..core import sort_and_merge_delete_set
-        from ..native import (
-            SRC_FRAMED,
-            SRC_SPILL,
-            NativeDecodeError,
-            encode_v1_update,
-            load,
-        )
+        from ..native import NativeDecodeError, encode_v1_update, load
 
         if load() is None:
             raise NativeDecodeError("native transcoder unavailable")
@@ -1559,6 +1655,7 @@ class DocMirror:
                 "clock", "length", "origin_client", "origin_clock",
                 "right_client", "right_clock", "content_ref",
                 "name_ofs", "name_len", "sub_ofs", "sub_len",
+                "parent_client", "parent_clock",
                 "src_kind", "src_buf", "src_ofs", "src_end",
             )
         }
@@ -1610,24 +1707,25 @@ class DocMirror:
             (ds_group_client, ds_group_start, ds_group_len,
              ds_clock, ds_len) = self._merged_ds_arrays()
         else:
-            from ..core import DeleteItem, DeleteSet
-
-            ds = DeleteSet()
+            by_client: dict[int, list[tuple[int, int]]] = {}
             for client, clock, ln in ds_ranges:
-                ds.clients.setdefault(client, []).append(DeleteItem(clock, ln))
-            sort_and_merge_delete_set(ds)
-            ds_group_client = np.asarray(list(ds.clients.keys()), np.int64)
+                by_client.setdefault(client, []).append((clock, ln))
+            merged = {
+                client: self._union_ranges(ranges)
+                for client, ranges in by_client.items()
+            }
+            ds_group_client = np.asarray(list(merged.keys()), np.int64)
             ds_group_len = np.asarray(
-                [len(v) for v in ds.clients.values()], np.int64
+                [len(v) for v in merged.values()], np.int64
             )
-            ds_group_start = np.zeros(len(ds.clients), np.int64)
-            if len(ds.clients) > 1:
+            ds_group_start = np.zeros(len(merged), np.int64)
+            if len(merged) > 1:
                 ds_group_start[1:] = np.cumsum(ds_group_len)[:-1]
             ds_clock = np.asarray(
-                [it.clock for v in ds.clients.values() for it in v], np.int64
+                [c for v in merged.values() for c, _l in v], np.int64
             )
             ds_len = np.asarray(
-                [it.len for v in ds.clients.values() for it in v], np.int64
+                [ln for v in merged.values() for _c, ln in v], np.int64
             )
 
         out_cap = (
@@ -1711,7 +1809,7 @@ class DocMirror:
             if rslot != NULL
             else None
         )
-        name, sub = self.seg_info[self.row_seg[row]]
+        name, sub, parent_row = self.seg_info[self.row_seg[row]]
         ref = self.row_content_ref[row]
         info = (
             ref
@@ -1725,8 +1823,18 @@ class DocMirror:
         if right is not None:
             encoder.write_right_id(right)
         if origin is None and right is None:
-            encoder.write_parent_info(True)  # device rows parent = root type
-            encoder.write_string(name)
+            if parent_row != NULL:
+                # nested type: parent is the type item's id (Item.js:644-648)
+                encoder.write_parent_info(False)
+                encoder.write_left_id(
+                    create_id(
+                        self.client_of_slot[self.row_slot[parent_row]],
+                        self.row_clock[parent_row],
+                    )
+                )
+            else:
+                encoder.write_parent_info(True)  # root-type key parent
+                encoder.write_string(name)
             if sub is not None:
                 encoder.write_string(sub)
         self.realized_content(row).write(encoder, offset)

@@ -4,10 +4,11 @@ The device-side half of the `y-tpu` Provider described in BASELINE.json's
 north star: pending binary updates from many docs are marshalled into
 struct-of-arrays columns (:mod:`.columns`), integrated by the vmapped YATA
 kernel (:mod:`.kernels`), and the persistent device state (links, segment
-heads, deleted bits) lives across flushes.  Root text/list types, multiple
-roots, and root YMaps are all served on device; docs whose updates fall
-outside the device path's scope (nested types, subdocs) transparently fall
-back to the CPU reference core — the Provider gating seam.
+heads, deleted bits) lives across flushes.  Root text/list/map types and
+arbitrarily nested shared types are all served on device (nested types are
+parent-row-keyed segments, reference ContentType.js); only docs embedding
+subdocuments transparently fall back to the CPU reference core — the
+Provider gating seam.
 """
 
 from __future__ import annotations
@@ -96,9 +97,9 @@ class BatchEngine:
     ----------
     n_docs: batch size.
     root_name: the default root type for text()/rows_in_order() when no
-        name is passed; any number of root text/list/map types per doc are
-        integrated on device (nested types and subdocs fall back to the
-        CPU core per doc).
+        name is passed; any number of root text/list/map types per doc —
+        and the shared types nested inside them — are integrated on
+        device (subdocs fall back to the CPU core per doc).
     """
 
     def __init__(
@@ -610,7 +611,7 @@ class BatchEngine:
                 item = item.right
             return out
         m = self.mirrors[doc]
-        seg = m.segments.get((name, None))
+        seg = m.segments.get((name, None, NULL))
         if seg is None:
             return []
         rows, dels = self._order(doc, seg)
@@ -631,7 +632,7 @@ class BatchEngine:
         if fb is not None:
             return fb.get_text(name).to_string()
         m = self.mirrors[doc]
-        seg = m.segments.get((name, None))
+        seg = m.segments.get((name, None, NULL))
         if seg is None:
             return ""
         rows, dels = self._order(doc, seg)
@@ -639,12 +640,92 @@ class BatchEngine:
 
     def map_json(self, doc: int, name: str | None = None) -> dict:
         """The visible {key: value} content of one root YMap (LWW winners,
-        reference typeMapGet / YMap.toJSON)."""
+        reference typeMapGet / YMap.toJSON); nested shared types render
+        recursively (dicts / lists / strings)."""
         name = name or self.root_name
         fb = self.fallback.get(doc)
         if fb is not None:
             return fb.get_map(name).to_json()
-        return self.mirrors[doc].map_json(name)
+        return self._map_json_of(doc, name, NULL)
+
+    def _map_json_of(self, doc: int, name: str | None, parent_row: int) -> dict:
+        m = self.mirrors[doc]
+        if parent_row != NULL:
+            # nested: the reverse index lists exactly this type's segments
+            segs = [
+                (m.seg_info[s][1], s)
+                for s in m._segs_of_parent.get(parent_row, ())
+                if m.seg_info[s][1] is not None
+            ]
+        else:
+            segs = [
+                (sub, seg)
+                for (n, sub, p), seg in m.segments.items()
+                if n == name and sub is not None and p == NULL
+            ]
+        out = {}
+        for sub, seg in segs:
+            chain = m.map_chain.get(seg)
+            if not chain:
+                continue
+            tail = chain[-1]
+            if tail in m._lww_deleted:
+                continue
+            out[sub] = self._value_of_row(doc, tail)
+        return out
+
+    def _value_of_row(self, doc: int, row: int):
+        """A row's visible value (reference typeMapGet: the last content
+        element), recursing into nested shared types."""
+        m = self.mirrors[doc]
+        content = m.realized_content(row)
+        if getattr(content, "REF", None) == 7:
+            return self._type_json(doc, row)
+        return content.get_content()[-1]
+
+    def _list_json(self, doc: int, seg: int) -> list:
+        """One list segment's visible values in document order, recursing
+        into nested shared types (reference YArray.toJSON)."""
+        m = self.mirrors[doc]
+        rows, dels = self._order(doc, seg)
+        out = []
+        for r, dl in zip(rows, dels):
+            if dl or not m.row_countable[r]:
+                continue
+            content = m.realized_content(r)
+            if getattr(content, "REF", None) == 7:
+                out.append(self._type_json(doc, r))
+            else:
+                out.extend(content.get_content())
+        return out
+
+    def _type_json(self, doc: int, row: int):
+        """Materialize a nested shared type held by ``row``'s ContentType:
+        maps render as dicts, text as strings, lists as JSON arrays
+        (reference YMap/YText/YArray .toJSON)."""
+        m = self.mirrors[doc]
+        kind = type(m.realized_content(row).type).__name__
+        if kind in ("YMap", "YXmlHook"):
+            return self._map_json_of(doc, None, row)
+        seg = m.segments.get((None, None, row))
+        if seg is None:
+            return "" if kind in ("YText", "YXmlText") else []
+        if kind in ("YText", "YXmlText"):
+            rows, dels = self._order(doc, seg)
+            return visible_text(m, rows, dels)
+        return self._list_json(doc, seg)
+
+    def to_json(self, doc: int, name: str | None = None):
+        """A root YArray's JSON content, nested types included
+        (reference YArray.toJSON)."""
+        name = name or self.root_name
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return fb.get_array(name).to_json()
+        seg = self.mirrors[doc].segments.get((name, None, NULL))
+        if seg is None:
+            return []
+        return self._list_json(doc, seg)
 
     def encode_state_vector(self, doc: int) -> bytes:
         fb = self.fallback.get(doc)
