@@ -89,10 +89,23 @@ def _engine_fuzz(gen: random.Random, n_ops: int, mesh=None) -> None:
             else:
                 pos = gen.randrange(ln)
                 t.delete(pos, min(gen.randint(1, 3), ln - pos))
-        elif op < 0.85:
+        elif op < 0.75:
             d.get_map("map").set(gen.choice("abcde"), gen.randrange(1000))
-        else:
+        elif op < 0.85:
             d.get_map("map").delete(gen.choice("abcde"))
+        elif op < 0.95:  # nested shared types on the device path
+            key = gen.choice("nm")
+            cur = d.get_map("map").get(key)
+            if cur is None or not hasattr(cur, "insert"):
+                d.get_map("map").set(key, Y.YText())
+            else:
+                cur.insert(len(cur.to_string()), gen.choice(["n", "est "]))
+        else:
+            arr = d.get_map("map").get("arr")
+            if arr is None or not hasattr(arr, "to_json"):
+                d.get_map("map").set("arr", Y.YArray())
+            else:
+                arr.insert(0, [gen.randrange(50)])
         if gen.random() < 0.3:  # random partial cross-client sync
             src, dst = gen.randrange(n_clients), gen.randrange(n_clients)
             for u in upds[src]:
@@ -116,6 +129,7 @@ def _engine_fuzz(gen: random.Random, n_ops: int, mesh=None) -> None:
     for other in docs[1:]:
         for name in ("text", "notes"):
             assert other.get_text(name).to_string() == ref.get_text(name).to_string()
+        assert other.get_map("map").to_json() == ref.get_map("map").to_json()
     for name in ("text", "notes"):
         assert eng.text(0, name) == ref.get_text(name).to_string()
     assert eng.map_json(0, "map") == ref.get_map("map").to_json()

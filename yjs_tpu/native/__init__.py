@@ -86,7 +86,7 @@ def encode_v1_update(
     out_cap: int,
 ) -> bytes:
     """Assemble a V1 update natively from pre-marshalled columns.  All
-    array arguments are int64 numpy arrays; ``row_cols`` holds the 16
+    array arguments are int64 numpy arrays; ``row_cols`` holds the 18
     per-row columns in ABI order.  Raises NativeDecodeError when the
     library is unavailable or encoding fails (caller falls back to the
     Python encoder)."""

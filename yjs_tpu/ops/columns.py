@@ -164,7 +164,7 @@ class ItemRef:
     origin: tuple[int, int] | None = None  # (client, clock)
     right_origin: tuple[int, int] | None = None
     parent_name: str | None = None  # root-type key
-    parent_id: tuple[int, int] | None = None  # nested parent (CPU-only path)
+    parent_id: tuple[int, int] | None = None  # nested type-item parent id
     parent_sub: str | None = None
     content: object | None = None  # AbstractContent | LazyContent; None = GC
     content_ref: int = 0  # wire content-ref (0 = GC struct)
@@ -1190,12 +1190,14 @@ class DocMirror:
             if i < 0:
                 i = 0
             end = clock + ln
+            # every covered row notes its own coverage in _delete_row (GC
+            # rows at creation, earlier deletions in their own step), so no
+            # range-level note is needed — it would only duplicate entries
             while i < len(fc) and fc[i] < end:
                 row = fr[i]
                 if fc[i] >= clock:
                     self._delete_row(row, plan)
                 i += 1
-            self._note_deleted(slot, clock, ln)
 
         self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
@@ -1407,35 +1409,9 @@ class DocMirror:
         for s, (_n, _s2, p) in enumerate(self.seg_info):
             if p != NULL:
                 self._segs_of_parent.setdefault(p, []).append(s)
-        # compact the host DS ranges too (sort + merge, DeleteSet.js:113-135)
+        # compact the host DS ranges too (sorted union)
         for slot, ranges in self.ds.items():
-            ranges.sort()
-            merged: list[tuple[int, int]] = []
-            for clock, ln in ranges:
-                if merged and clock <= merged[-1][0] + merged[-1][1]:
-                    last_c, last_l = merged[-1]
-                    merged[-1] = (last_c, max(last_l, clock + ln - last_c))
-                else:
-                    merged.append((clock, ln))
-            self.ds[slot] = merged
-
-    def map_json(self, name: str) -> dict:
-        """The visible {key: value} of a root YMap — value = the final chain
-        tail's last content element (reference typeMapGet,
-        src/types/AbstractType.js:839-845)."""
-        out = {}
-        for (n, sub, p), seg in self.segments.items():
-            if n != name or sub is None or p != NULL:
-                continue
-            chain = self.map_chain.get(seg)
-            if not chain:
-                continue
-            tail = chain[-1]
-            if tail in self._lww_deleted:
-                continue
-            content = self.realized_content(tail)
-            out[sub] = content.get_content()[-1]
-        return out
+            self.ds[slot] = self._union_ranges(ranges)
 
     def state_vector(self) -> dict[int, int]:
         return {
