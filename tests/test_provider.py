@@ -67,6 +67,40 @@ class TestProvider:
         ]
         assert prov.metrics["n_demoted"] == 1
 
+    def test_backend_cpu_serves_everything_without_device(self):
+        prov = TpuProvider(2, backend="cpu")
+        d = Y.Doc(gc=False)
+        d.client_id = 5
+        d.get_text("text").insert(0, "cpu-only")
+        d.get_map("m").set("sub", Y.Doc(guid="child"))  # fine on CPU
+        prov.receive_update("room", Y.encode_state_as_update(d))
+        prov.flush()
+        assert prov.text("room") == "cpu-only"
+        assert prov.n_fallback_docs == 1  # lazily, only the allocated room
+        assert prov.demotions == []  # by configuration, not by gap
+
+    def test_backend_device_forbids_fallback(self):
+        import pytest as _pytest
+
+        prov = TpuProvider(2, backend="device")
+        ok = Y.Doc(gc=False)
+        ok.client_id = 6
+        ok.get_text("text").insert(0, "fine")
+        prov.receive_update("a", Y.encode_state_as_update(ok))
+        prov.flush()
+        assert prov.text("a") == "fine"
+        bad = Y.Doc(gc=False)
+        bad.client_id = 7
+        bad.get_map("m").set("sub", Y.Doc(guid="child"))
+        prov.receive_update("b", Y.encode_state_as_update(bad))
+        with _pytest.raises(RuntimeError, match="forbids CPU fallback"):
+            prov.flush()
+        # the alert persists on every flush while the demotion exists —
+        # not a one-shot warning (data stays served by the CPU core)
+        prov.receive_update("a", Y.encode_state_as_update(ok))
+        with _pytest.raises(RuntimeError, match="forbids CPU fallback"):
+            prov.flush()
+
     def test_nested_room_stays_on_device(self):
         prov = TpuProvider(2)
         d = Y.Doc(gc=False)

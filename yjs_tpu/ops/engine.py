@@ -109,12 +109,20 @@ class BatchEngine:
         mesh=None,
         gc: bool = False,
         compact_min_rows: int = 512,
+        policy: str = "auto",
     ):
+        if policy not in ("auto", "cpu", "device"):
+            raise ValueError(f"unknown policy {policy!r}")
         self.n_docs = n_docs
         self.root_name = root_name
         self.mesh = mesh
         self.gc = gc
         self.compact_min_rows = compact_min_rows
+        # backend policy: "auto" demotes out-of-scope docs to the CPU core,
+        # "cpu" serves every doc on the CPU core (lazily, no device work),
+        # "device" records demotions as in auto (state stays consistent and
+        # no data is lost) but the Provider raises while any exist
+        self.policy = policy
         # per-doc row count at the last compaction (growth trigger)
         self._rows_at_compact = [0] * n_docs
         # per-doc stats of the most recent flush's compactions
@@ -164,12 +172,23 @@ class BatchEngine:
 
     def queue_update(self, doc: int, update: bytes, v2: bool = False) -> None:
         fb = self.fallback.get(doc)
+        if fb is None and self.policy == "cpu":
+            fb = self._cpu_serve(doc)
         if fb is not None:
-            # demoted docs apply directly; the log is dead weight for them
+            # CPU-served docs apply directly; the log is dead weight for them
             (apply_update_v2 if v2 else apply_update)(fb, update)
         else:
             self._update_log[doc].append((update, v2))
             self.mirrors[doc].ingest(update, v2)
+
+    def _cpu_serve(self, doc: int) -> Doc:
+        """Route a doc to the CPU reference core by configuration (policy
+        'cpu') — not a demotion, so it is not recorded as one."""
+        fb = Doc(gc=False)
+        self.fallback[doc] = fb
+        self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
+        fb.on("update", lambda u, origin, d, i=doc: self._emit(i, u))
+        return fb
 
     def on_update(self, callback) -> None:
         """Register ``callback(doc_idx, update_bytes)`` — called after each

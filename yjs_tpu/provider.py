@@ -21,12 +21,33 @@ from .sync import protocol
 
 
 class TpuProvider:
-    """Batched multi-doc provider backed by :class:`BatchEngine`."""
+    """Batched multi-doc provider backed by :class:`BatchEngine`.
+
+    ``backend`` is the selector the north star puts at the Provider
+    boundary (BASELINE.json: "the Provider plugin boundary gates whether
+    applyUpdate dispatches to the JS path or the TPU batch path"):
+
+    - ``"auto"`` (default): device path, transparently demoting docs whose
+      traffic is out of scope (subdocuments) to the CPU core.
+    - ``"cpu"``: every doc on the CPU reference core (the interactive
+      path; no device work at all).
+    - ``"device"``: device path with demotion FORBIDDEN — out-of-scope
+      traffic raises instead, for deployments that must not absorb CPU
+      work silently.
+    """
 
     def __init__(
-        self, n_docs: int, root_name: str = "text", mesh=None, gc: bool = False
+        self,
+        n_docs: int,
+        root_name: str = "text",
+        mesh=None,
+        gc: bool = False,
+        backend: str = "auto",
     ):
-        self.engine = BatchEngine(n_docs, root_name=root_name, mesh=mesh, gc=gc)
+        self.backend = backend
+        self.engine = BatchEngine(
+            n_docs, root_name=root_name, mesh=mesh, gc=gc, policy=backend
+        )
         self._guids: dict[str, int] = {}
         self._guid_of: dict[int, str] = {}
         self._next = 0
@@ -61,10 +82,23 @@ class TpuProvider:
         self._dirty = True
 
     def flush(self) -> None:
-        """Run one batched device integration step over all pending docs."""
+        """Run one batched device integration step over all pending docs.
+
+        Under ``backend='device'`` this raises while ANY demoted doc
+        exists (not just on the flush that demoted it): the demoted docs
+        stay served by the CPU core so no data is lost, but the operator
+        is alerted on every flush until they act."""
         if self._dirty:
             self.engine.flush()
             self._dirty = False
+        if self.backend == "device" and self.engine.fallback:
+            d = self.engine.demotions[0]
+            raise RuntimeError(
+                f"backend='device' forbids CPU fallback: doc "
+                f"{self._guid_of.get(d['doc'], d['doc'])!r} demoted "
+                f"({d['reason']}); {len(self.engine.fallback)} doc(s) on "
+                f"the CPU path"
+            )
 
     # -- y-protocols sync framing ------------------------------------------
 
