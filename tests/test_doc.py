@@ -175,3 +175,47 @@ def test_pending_delete_sets_are_buffered():
     assert len(remote.store.pending_delete_readers) > 0
     Y.apply_update(remote, updates[0])
     assert remote.get_text("t").to_string() == "ac"
+
+
+def test_late_edit_into_gcd_origin_degrades():
+    """An item whose origin run was replaced by a GC struct before it
+    arrived must degrade, not crash (reference Item.js:369-377:
+    `this.left.lastId` on a GC yields undefined; the GC check nulls the
+    parent and integrate turns the item into a GC struct).  A GC'd
+    nested subtree produces real GC origins: ContentType.gc replaces the
+    children with GC structs (ContentType.js:134-148)."""
+    a = Y.Doc(gc=True)
+    a.client_id = 1
+    arr = a.get_array("root")
+    nested = Y.YArray()
+    arr.insert(0, [nested])
+    nested.insert(0, [1, 2, 3])
+    b = Y.Doc(gc=False)
+    b.client_id = 2
+    Y.apply_update(b, Y.encode_state_as_update(a))
+    sv_a = Y.encode_state_vector(a)
+    b.get_array("root").get(0).insert(3, [4])  # origin = last nested item
+    u_late = Y.encode_state_as_update(b, sv_a)
+    arr.delete(0, 1)  # deletes the type; gc replaces the subtree with GC
+    Y.apply_update(a, u_late)  # crashed (AttributeError) before the fix
+    assert a.get_array("root").to_json() == []
+    # the degraded struct still advances the state vector
+    assert Y.decode_state_vector(Y.encode_state_vector(a))[2] == 1
+
+
+def test_partial_run_into_gcd_prefix_degrades():
+    """integrate's offset>0 split path hits the same GC-origin class: a run
+    spanning the receiver's state boundary whose known prefix was GC'd
+    (reference Item.js:404-409 reads `.lastId` as undefined)."""
+    a = Y.Doc(gc=True)
+    a.client_id = 1
+    b = Y.Doc(gc=False)
+    b.client_id = 2
+    nested = Y.YArray()
+    b.get_array("root").insert(0, [nested])
+    nested.insert(0, [1, 2])
+    Y.apply_update(a, Y.encode_state_as_update(b))
+    nested.insert(2, [3, 4])  # merges into one run spanning the boundary
+    a.get_array("root").delete(0, 1)  # GC the subtree at the receiver
+    Y.apply_update(a, Y.encode_state_as_update(b))  # full update, offset>0
+    assert a.get_array("root").to_json() == []

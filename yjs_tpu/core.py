@@ -709,7 +709,12 @@ class Item:
         # all dependencies known; resolve them into pointers
         if origin is not None:
             self.left = get_item_clean_end(transaction, store, origin)
-            self.origin = self.left.last_id
+            # the origin may resolve into a GC run (tombstoned before this
+            # item arrived); JS reads `.lastId` as undefined and the GC
+            # check below degrades the item (reference Item.js:369-377)
+            self.origin = (
+                self.left.last_id if type(self.left) is Item else None
+            )
         if right_origin is not None:
             self.right = get_item_clean_start(transaction, right_origin)
             self.right_origin = self.right.id
@@ -745,7 +750,11 @@ class Item:
             self.left = get_item_clean_end(
                 transaction, transaction.doc.store, create_id(self.id.client, self.id.clock - 1)
             )
-            self.origin = self.left.last_id
+            # the known prefix may have been replaced by a GC run; JS reads
+            # `.lastId` as undefined and proceeds (reference Item.js:404-409)
+            self.origin = (
+                self.left.last_id if type(self.left) is Item else None
+            )
             self.content = self.content.splice(offset)
             self.length -= offset
 
