@@ -12,6 +12,23 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# hermetic + fast: the suite never needs a real accelerator (bench.py and
+# the driver exercise the TPU path); forcing the CPU platform keeps engine
+# tests off a potentially contended/skewed tunnel chip.  The ambient env
+# may pin JAX_PLATFORMS to an accelerator plugin and site hooks may have
+# imported jax already, so set both the env and the live config (backends
+# are not initialized yet at conftest time).  YTPU_TEST_PLATFORM overrides.
+_platform = os.environ.get("YTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", _platform)
+    except Exception:
+        pass
 
 
 @pytest.fixture

@@ -135,6 +135,12 @@ class BatchEngine:
         # cached sharded state-vector callables keyed by n_slots (jit's
         # cache is per function identity — rebuilding retraces every call)
         self._sharded_sv: dict[int, object] = {}
+        # explicit placement: a meshed engine pins EVERY host->device
+        # transfer to the mesh's devices so it can never touch the default
+        # backend (the mesh may be a virtual CPU mesh while the default
+        # platform is a real accelerator — the multichip dry-run context)
+        self._ns_batch = None  # [B, ...] arrays, doc axis sharded
+        self._ns_repl = None  # small aux arrays, replicated over the mesh
         if mesh is not None:
             doc_axis = mesh.axis_names[0]
             axis_size = mesh.shape[doc_axis]
@@ -143,6 +149,10 @@ class BatchEngine:
                     f"n_docs={n_docs} must be a multiple of the {doc_axis!r} "
                     f"axis size {axis_size}"
                 )
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._ns_batch = NamedSharding(mesh, PartitionSpec(doc_axis))
+            self._ns_repl = NamedSharding(mesh, PartitionSpec())
             from ..parallel.mesh import sharded_batch_step
 
             self._sharded_step = sharded_batch_step(mesh, doc_axis)
@@ -238,6 +248,22 @@ class BatchEngine:
         fb.on("update", lambda u, origin, d, i=doc: self._emit(i, u))
         return fb
 
+    # -- device placement ---------------------------------------------------
+
+    def _put_b(self, x):
+        """Place a batch-leading [B, ...] array: doc-axis sharded over the
+        mesh, or the default device when unmeshed."""
+        if self._ns_batch is None:
+            return jnp.asarray(x)
+        return jax.device_put(np.asarray(x), self._ns_batch)
+
+    def _put_r(self, x):
+        """Place an auxiliary array replicated over the mesh (or default
+        device when unmeshed)."""
+        if self._ns_repl is None:
+            return jnp.asarray(x)
+        return jax.device_put(np.asarray(x), self._ns_repl)
+
     # -- device state management -------------------------------------------
 
     _STATIC_COLS = (
@@ -270,9 +296,9 @@ class BatchEngine:
             new_right[:, :old_cap] = np.asarray(self._right)[:, :old_cap]
             new_deleted[:, :old_cap] = np.asarray(self._deleted)[:, :old_cap]
             new_starts[:, :old_seg] = np.asarray(self._starts)[:, :old_seg]
-        self._right = jnp.asarray(new_right)
-        self._deleted = jnp.asarray(new_deleted)
-        self._starts = jnp.asarray(new_starts)
+        self._right = self._put_b(new_right)
+        self._deleted = self._put_b(new_deleted)
+        self._starts = self._put_b(new_starts)
         # grow the resident statics device-side (pad, no host round trip)
         old_statics = self._statics
         self._statics = {}
@@ -284,7 +310,9 @@ class BatchEngine:
                     constant_values=fill,
                 )
             else:
-                self._statics[key] = jnp.full((b, self._cap + 1), fill, dtype)
+                self._statics[key] = self._put_b(
+                    np.full((b, self._cap + 1), fill, np.dtype(dtype))
+                )
 
     def _upload_statics(self, plans) -> None:
         """Scatter this flush's NEW/changed rows into the resident statics.
@@ -331,9 +359,9 @@ class BatchEngine:
                 v = np.concatenate(
                     [v, np.full(padded - total, fill, v.dtype)]
                 )
-            vpad[k] = jnp.asarray(v)
+            vpad[k] = self._put_r(v)
         self._statics = _scatter_statics(
-            self._statics, jnp.asarray(d), jnp.asarray(r), vpad
+            self._statics, self._put_r(d), self._put_r(r), vpad
         )
 
     # -- compaction ---------------------------------------------------------
@@ -356,7 +384,7 @@ class BatchEngine:
         # transfer only the compacting docs' rows (device gather), rebuild
         # host-side, then scatter the rebuilt rows back — O(|todo| * N)
         # traffic, not O(B * N)
-        idx = jnp.asarray(todo)
+        idx = self._put_r(np.asarray(todo, np.int32))
         right = np.asarray(self._right[idx])
         deleted = np.asarray(self._deleted[idx])
         starts = np.asarray(self._starts[idx])
@@ -377,9 +405,9 @@ class BatchEngine:
             self.last_compaction.append(
                 {"doc": i, "rows_before": old_n, "rows_after": n_new}
             )
-        self._right = self._right.at[idx].set(new_right)
-        self._deleted = self._deleted.at[idx].set(new_deleted)
-        self._starts = self._starts.at[idx].set(new_starts)
+        self._right = self._right.at[idx].set(self._put_r(new_right))
+        self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
+        self._starts = self._starts.at[idx].set(self._put_r(new_starts))
 
     # -- flush: run one device integration step ----------------------------
 
@@ -484,8 +512,8 @@ class BatchEngine:
             if os.environ.get("YTPU_KERNEL") == "seq":
                 self._metrics_dev = None  # no sharded counters this flush
                 dyn = kernels.batch_step(
-                    statics, dyn, jnp.asarray(splits), jnp.asarray(sched),
-                    jnp.asarray(dels),
+                    statics, dyn, self._put_b(splits), self._put_b(sched),
+                    self._put_b(dels),
                 )
             else:
                 # blockwise over the level axis (the long-context analogue,
@@ -503,18 +531,18 @@ class BatchEngine:
                 )
                 empty_splits = empty_dels = None
                 if n_lv > block:  # multi-block: cache the no-op inputs
-                    empty_splits = jnp.full((b, 1, 2), NULL, jnp.int32)
-                    empty_dels = jnp.full((b, 1), NULL, jnp.int32)
-                scratch_d = jnp.asarray(scratch_base)
+                    empty_splits = self._put_b(np.full((b, 1, 2), NULL, np.int32))
+                    empty_dels = self._put_b(np.full((b, 1), NULL, np.int32))
+                scratch_d = self._put_b(scratch_base)
                 self._metrics_dev = None
                 for c0 in range(0, n_lv, block):
                     c1 = min(n_lv, c0 + block)
                     args = (
                         statics,
                         dyn,
-                        jnp.asarray(splits) if c0 == 0 else empty_splits,
-                        jnp.asarray(lv_sched[:, c0:c1]),
-                        jnp.asarray(dels) if c1 == n_lv else empty_dels,
+                        self._put_b(splits) if c0 == 0 else empty_splits,
+                        self._put_b(lv_sched[:, c0:c1]),
+                        self._put_b(dels) if c1 == n_lv else empty_dels,
                         scratch_d,
                     )
                     if self._sharded_step is not None:
@@ -607,7 +635,9 @@ class BatchEngine:
         if n:
             valid_host[:n] = np.asarray(m.row_seg[:n], np.int32) == seg
         d = np.asarray(
-            kernels.list_ranks(self._right[doc : doc + 1], jnp.asarray(valid_host)[None])
+            kernels.list_ranks(
+                self._right[doc : doc + 1], self._put_r(valid_host[None])
+            )
         )[0]
         deleted = np.asarray(self._deleted)[doc]
         rows = np.nonzero(d >= 0)[0]
@@ -830,7 +860,7 @@ class BatchEngine:
                     f = sharded_state_vectors(self.mesh, n_slots, axis)
                     self._sharded_sv[n_slots] = f
                 sv = np.asarray(
-                    f(jnp.asarray(row_slot), jnp.asarray(row_end))
+                    f(self._put_b(row_slot), self._put_b(row_end))
                 )
             else:
                 sv = np.asarray(
@@ -881,10 +911,10 @@ class BatchEngine:
                     if s is not None:
                         sv_dense[r, s] = clock
             needed, offset = kernels.diff_mask_kernel(
-                jnp.asarray(row_slot),
-                jnp.asarray(row_clock),
-                jnp.asarray(row_end),
-                jnp.asarray(sv_dense),
+                self._put_r(row_slot),
+                self._put_r(row_clock),
+                self._put_r(row_end),
+                self._put_r(sv_dense),
             )
             needed = np.asarray(needed)
             offset = np.asarray(offset)
