@@ -1,0 +1,237 @@
+"""Differential tests: NativeMirror (C++ plan core) vs DocMirror (Python
+oracle).  The two implement the same flush pipeline (reference
+encoding.js:225-321 recast per SURVEY.md §7); plans and columns must agree
+step for step on arbitrary traffic."""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.ops.columns import DocMirror, UnsupportedUpdate
+from yjs_tpu.ops.native_mirror import NativeMirror, native_plan_available
+
+pytestmark = pytest.mark.skipif(
+    not native_plan_available(), reason="native plan core unavailable"
+)
+
+COLS = (
+    "row_slot", "row_clock", "row_len", "row_origin_slot",
+    "row_origin_clock", "row_right_slot", "row_right_clock", "row_is_gc",
+    "row_countable", "row_content_ref", "row_seg", "client_of_slot",
+    "state", "seg_info",
+)
+
+
+def assert_step_equal(pm, nm, pp, np_, ctx=""):
+    assert pm.n_rows == nm.n_rows, ctx
+    assert pp.n_levels == np_.n_levels, ctx
+    assert getattr(pp, "max_width", 0) == np_.max_width, ctx
+    assert pp.splits == list(map(tuple, np_.splits.tolist())), ctx
+    assert pp.sched == list(map(tuple, np_.sched.tolist())), ctx
+    assert pp.sched8 == list(map(tuple, np_.sched8.tolist())), ctx
+    assert pp.levels == np_.levels.tolist(), ctx
+    assert sorted(pp.delete_rows) == sorted(np_.delete_rows.tolist()), ctx
+    assert sorted(pp.applied_ds) == sorted(np_.applied_ds), ctx
+
+
+def assert_state_equal(pm, nm, ctx="", encode=True):
+    for attr in COLS:
+        assert list(getattr(pm, attr)) == list(getattr(nm, attr)), (
+            f"{attr} differs {ctx}"
+        )
+    assert pm.state_vector() == nm.state_vector(), ctx
+    assert pm.has_pending() == nm.has_pending(), ctx
+    assert pm.pending_depth() == nm.pending_depth(), ctx
+    sp, sn = pm.static_columns(), nm.static_columns()
+    for k in sp:
+        assert (sp[k] == sn[k]).all(), f"static {k} {ctx}"
+    assert pm.map_chain == {
+        k: list(v) for k, v in nm.map_chain.items()
+    }, ctx
+    assert pm._lww_deleted == nm._lww_deleted, ctx
+    assert pm._host_deleted_rows == nm._host_deleted_rows, ctx
+    if encode:
+        assert pm.encode_state_vector() == nm.encode_state_vector(), ctx
+        # state equivalence of the wire encodes (bytes may differ when the
+        # Python mirror spills realized content; decoded state must not)
+        a, b = Y.Doc(gc=False), Y.Doc(gc=False)
+        Y.apply_update(a, pm.encode_state_as_update())
+        Y.apply_update(b, nm.encode_state_as_update())
+        assert Y.encode_state_as_update(a) is not None
+        assert a.get_text("text").to_string() == b.get_text("text").to_string(), ctx
+        assert Y.decode_state_vector(
+            Y.encode_state_vector(a)
+        ) == Y.decode_state_vector(Y.encode_state_vector(b)), ctx
+
+
+def run_differential(updates, v2=False, flush_every=1):
+    pm, nm = DocMirror("text"), NativeMirror("text")
+    for j, u in enumerate(updates):
+        pm.ingest(u, v2)
+        nm.ingest(u, v2)
+        if (j + 1) % flush_every == 0 or j == len(updates) - 1:
+            pp = pm.prepare_step()
+            np_ = nm.prepare_step()
+            assert_step_equal(pm, nm, pp, np_, ctx=f"flush after update {j}")
+    assert_state_equal(pm, nm, ctx="final")
+    return pm, nm
+
+
+def two_client_session(rng, n_rounds, rich=False, astral=False):
+    """Concurrent editing session; returns the per-round deltas of both
+    clients (interleaved) plus the final docs."""
+    a = Y.Doc(gc=False); a.client_id = 100
+    b = Y.Doc(gc=False); b.client_id = 200
+    updates = []
+    words = ["alpha ", "beta ", "gamma", "δδ ", "é "]
+    if astral:
+        words += ["x\U0001F600y", "\U0001F680\U0001F680"]
+    for _ in range(n_rounds):
+        for d in (a, b):
+            sv = Y.encode_state_vector(d)
+            t = d.get_text("text")
+            m = d.get_map("meta")
+            arr = d.get_array("list")
+            op = rng.random()
+            if op < 0.45 or len(t) == 0:
+                t.insert(rng.randint(0, len(t)), rng.choice(words))
+            elif op < 0.65:
+                pos = rng.randrange(len(t))
+                t.delete(pos, min(rng.randint(1, 5), len(t) - pos))
+            elif op < 0.75:
+                m.set(rng.choice("abc"), rng.randint(0, 99))
+            elif op < 0.85:
+                arr.insert(
+                    rng.randint(0, len(arr)),
+                    [rng.randint(0, 9), "s", None, True],
+                )
+            elif rich:
+                if rng.random() < 0.5 and len(t) > 2:
+                    pos = rng.randrange(len(t) - 1)
+                    t.format(pos, 2, {"bold": True})
+                else:
+                    nested = Y.YMap()
+                    m.set("nested", nested)
+                    nested.set("k", rng.randint(0, 9))
+            elif len(t) > 0:
+                pos = rng.randrange(len(t))
+                t.delete(pos, min(1, len(t) - pos))
+            updates.append(Y.encode_state_as_update(d, sv))
+        if rng.random() < 0.4:  # cross-sync so edits become concurrent
+            ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+            ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+            Y.apply_update(b, ua)
+            Y.apply_update(a, ub)
+    ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+    ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+    Y.apply_update(b, ua)
+    Y.apply_update(a, ub)
+    updates += [ua, ub]
+    return updates, a, b
+
+
+def test_plain_text_session(rng):
+    updates, a, _ = two_client_session(rng, 60)
+    pm, nm = run_differential(updates, flush_every=3)
+    # converged content matches the CPU doc
+    assert pm.state_vector() == {
+        c: v for c, v in Y.get_state_vector(a.store).items() if v > 0
+    }
+
+
+def test_rich_session_maps_nested_formats(rng):
+    updates, _, _ = two_client_session(rng, 60, rich=True)
+    run_differential(updates, flush_every=2)
+
+
+def test_astral_surrogate_splits(rng):
+    updates, _, _ = two_client_session(rng, 40, astral=True)
+    run_differential(updates, flush_every=1)
+
+
+def test_random_delivery_order_pending(rng):
+    updates, _, _ = two_client_session(rng, 50)
+    shuffled = list(updates)
+    rng.shuffle(shuffled)
+    run_differential(shuffled, flush_every=4)
+
+
+def test_v2_wire(rng):
+    from yjs_tpu.coding import use_v1_encoding, use_v2_encoding
+
+    use_v2_encoding()
+    try:
+        updates, _, _ = two_client_session(rng, 40, rich=True)
+    finally:
+        use_v1_encoding()
+    run_differential(updates, v2=True, flush_every=2)
+
+
+def test_gc_tombstones_in_updates(rng):
+    # a doc WITH gc produces GC structs in its full-state updates
+    d = Y.Doc(gc=True)
+    d.client_id = 77
+    t = d.get_text("text")
+    t.insert(0, "hello world, this will be partially gc'd")
+    t.delete(3, 10)
+    t.insert(5, "more")
+    u = Y.encode_state_as_update(d)
+    run_differential([u])
+
+
+def test_subdocument_raises_unsupported():
+    d = Y.Doc(gc=False)
+    d.client_id = 5
+    sub = Y.Doc()
+    d.get_map("m").set("sub", sub)
+    u = Y.encode_state_as_update(d)
+    nm = NativeMirror("text")
+    nm.ingest(u)
+    with pytest.raises(UnsupportedUpdate):
+        nm.prepare_step()
+
+
+def test_malformed_raises_like_python():
+    nm = NativeMirror("text")
+    nm.ingest(b"\x9f\x83garbage!!\x00\xff")
+    with pytest.raises(Exception) as native_err:
+        nm.prepare_step()
+    pm = DocMirror("text")
+    pm.ingest(b"\x9f\x83garbage!!\x00\xff")
+    with pytest.raises(Exception) as py_err:
+        pm.prepare_step()
+    assert type(native_err.value) is type(py_err.value)
+    assert not isinstance(native_err.value, UnsupportedUpdate)
+
+
+def test_compaction_parity(rng):
+    """Full engine-level compaction: run the same traffic through two
+    engines (one per mirror backend) and compare exports after compaction
+    triggers."""
+    import os
+
+    from yjs_tpu.ops import BatchEngine
+
+    updates, a, _ = two_client_session(rng, 80)
+    texts = {}
+    for backend in ("native", "python"):
+        if backend == "python":
+            os.environ["YTPU_NO_NATIVE_PLAN"] = "1"
+        try:
+            eng = BatchEngine(1, compact_min_rows=8, gc=True)
+            for j, u in enumerate(updates):
+                eng.queue_update(0, u)
+                if j % 5 == 4:
+                    eng.flush()
+            eng.flush()
+            texts[backend] = (
+                eng.text(0),
+                eng.state_vector(0),
+                eng.to_json(0, "list"),
+                eng.map_json(0, "meta"),
+            )
+        finally:
+            os.environ.pop("YTPU_NO_NATIVE_PLAN", None)
+    assert texts["native"] == texts["python"]
+    assert texts["native"][0] == a.get_text("text").to_string()

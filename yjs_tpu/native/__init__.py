@@ -18,8 +18,11 @@ import numpy as np
 
 logger = logging.getLogger("yjs_tpu.native")
 
-_SRC = os.path.join(os.path.dirname(__file__), "transcode.cpp")
-_SO = os.path.join(os.path.dirname(__file__), "_transcode.so")
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "transcode.cpp")
+_SRC_PLAN = os.path.join(_DIR, "plancore.cpp")
+_SRC_WIRE = os.path.join(_DIR, "wire.h")
+_SO = os.path.join(_DIR, "_transcode.so")
 
 _lib = None
 _tried = False
@@ -27,11 +30,12 @@ _tried = False
 
 def _build() -> bool:
     try:
+        srcs = [_SRC] + ([_SRC_PLAN] if os.path.exists(_SRC_PLAN) else [])
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO] + srcs,
             check=True,
             capture_output=True,
-            timeout=120,
+            timeout=240,
         )
         return True
     except subprocess.CalledProcessError as e:
@@ -59,10 +63,10 @@ def load():
     if os.environ.get("YTPU_NO_NATIVE"):
         return None
     # a shipped .so with no source is fine (binary-only install); rebuild
-    # only when the source exists and is newer
-    needs_build = not os.path.exists(_SO) or (
-        os.path.exists(_SRC)
-        and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    # only when a source file exists and is newer
+    needs_build = not os.path.exists(_SO) or any(
+        os.path.exists(s) and os.path.getmtime(_SO) < os.path.getmtime(s)
+        for s in (_SRC, _SRC_PLAN, _SRC_WIRE)
     )
     if needs_build:
         if not _build():
@@ -95,12 +99,85 @@ def load():
         + [i64p] * 3 + [ctypes.c_uint64] + [i64p] * 2     # ds groups
         + [u8p, ctypes.c_uint64]                          # out
     )
+    # plan-core (plancore.cpp) entry points; absent in a stale binary-only
+    # .so — the caller checks has_plancore()
+    try:
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        vp = ctypes.c_void_p
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.ymx_new.restype = vp
+        lib.ymx_free.argtypes = [vp]
+        lib.ymx_add_buf.restype = i64
+        lib.ymx_add_buf.argtypes = [vp, u8p, u64]
+        lib.ymx_n_bufs.restype = i64
+        lib.ymx_n_bufs.argtypes = [vp]
+        lib.ymx_buf_len.restype = i64
+        lib.ymx_buf_len.argtypes = [vp, i64]
+        lib.ymx_prepare.restype = ctypes.c_int
+        lib.ymx_prepare.argtypes = [vp, i64p, i64p, i64, i64p]
+        for name, args in [
+            ("ymx_plan_splits", [vp, i64p]),
+            ("ymx_plan_sched", [vp, i64p]),
+            ("ymx_plan_sched8", [vp, i64p, i64p]),
+            ("ymx_plan_deletes", [vp, i64p]),
+            ("ymx_plan_applied_ds", [vp, i64p]),
+            ("ymx_clients", [vp, i64p]),
+            ("ymx_state", [vp, i64p]),
+            ("ymx_segs", [vp, i64p, i64p, i64p, i64p, i64p]),
+            ("ymx_strings", [vp, u8p]),
+            ("ymx_chain", [vp, i64, i64p]),
+            ("ymx_ds", [vp, i64p, i64p, i64p]),
+        ]:
+            getattr(lib, name).restype = None
+            getattr(lib, name).argtypes = args
+        lib.ymx_frag_counts.restype = None
+        lib.ymx_frag_counts.argtypes = [vp, i64p]
+        lib.ymx_frag.restype = None
+        lib.ymx_frag.argtypes = [vp, i64, i64p, i64p]
+        lib.ymx_drop_bufs_from.restype = None
+        lib.ymx_drop_bufs_from.argtypes = [vp, i64]
+        for name in ("ymx_n_rows", "ymx_n_slots", "ymx_n_segs",
+                     "ymx_pending_depth", "ymx_ds_count"):
+            getattr(lib, name).restype = i64
+            getattr(lib, name).argtypes = [vp]
+        lib.ymx_gen.restype = u64
+        lib.ymx_gen.argtypes = [vp]
+        lib.ymx_strings_len.restype = u64
+        lib.ymx_strings_len.argtypes = [vp]
+        lib.ymx_chain_len.restype = i64
+        lib.ymx_chain_len.argtypes = [vp, i64]
+        lib.ymx_has_pending.restype = ctypes.c_int
+        lib.ymx_has_pending.argtypes = [vp]
+        lib.ymx_rows.restype = None
+        lib.ymx_rows.argtypes = [vp, i64] + [i64p] * 21
+        lib.ymx_static_cols.restype = None
+        lib.ymx_static_cols.argtypes = [vp, i64, u32p] + [i32p] * 5
+        lib.ymx_copy_bytes.restype = ctypes.c_int
+        lib.ymx_copy_bytes.argtypes = [vp, i64, i64, i64, u8p]
+        lib.ymx_compact.restype = i64
+        lib.ymx_compact.argtypes = [vp, i32p, u8p, i32p, i64, ctypes.c_int,
+                                    i32p, u8p, i32p, i64]
+        lib._has_plancore = True
+    except AttributeError:
+        lib._has_plancore = False
     _lib = lib
     return _lib
 
 
+def has_plancore() -> bool:
+    lib = load()
+    return bool(lib is not None and getattr(lib, "_has_plancore", False))
+
+
 # content-source kinds for ytpu_encode_v1 (must match transcode.cpp)
 SRC_NONE, SRC_DELETED, SRC_FRAMED, SRC_UTF8, SRC_SPILL = 0, 1, 2, 3, 4
+# element-range kinds emitted by the native plan builder (plancore.cpp):
+# `length` elements at [ofs,end) — ContentAny any-values / ContentJSON
+# var_strings; SRC_V2LAZY marks V2-framed embed/format/type payloads that
+# must be re-framed via the Python spill path when writing V1
+SRC_ANYS, SRC_JSONS, SRC_V2LAZY = 5, 6, 7
 
 
 def encode_v1_update(

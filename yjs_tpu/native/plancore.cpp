@@ -1,0 +1,1768 @@
+// Native plan builder: the persistent host mirror of one document's struct
+// columns, with the full flush pipeline (wire scan -> causal schedule ->
+// pre-split -> row assignment -> level-parallel schedule) implemented in
+// C++.  This is the C++ twin of yjs_tpu/ops/columns.py DocMirror
+// (reference pipeline: src/utils/encoding.js:127-198,225-321 decode +
+// dependency-stack integration, src/structs/Item.js:84-120 splitItem,
+// :354-397 getMissing, :403-517 integrate; recast as the columnar plan of
+// SURVEY.md §7).  Python keeps a semantically identical pure-Python
+// implementation as the conformance oracle; the differential fuzz tests
+// assert plan-for-plan equality between the two.
+//
+// Ownership/ABI: one `Mirror` per doc behind an opaque handle.  Update
+// buffers are borrowed (Python keeps the bytes objects alive and passes
+// stable pointers); synthesized content (surrogate-straddling splits,
+// compaction merges) lives in mirror-owned arena buffers registered in the
+// same buffer table.  All plan/state getters fill caller-allocated numpy
+// arrays.  Row content is described by (src_kind, buf, ofs, end, ...)
+// descriptor columns; Python realizes payload objects lazily from these.
+
+#include "wire.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+
+#include <algorithm>
+#include <array>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+using namespace ytpu_wire;
+
+namespace {
+
+constexpr int64_t kNull = -1;
+// sched8 sentinels (shared with yjs_tpu/ops/kernels.py)
+constexpr int64_t kNoLeftWrite = -3;
+constexpr int64_t kGatherSucc = -2;
+
+// content-source kinds (superset of yjs_tpu/native/__init__.py SRC_*)
+constexpr int64_t kKindNone = 0;     // GC row
+constexpr int64_t kKindDeleted = 1;  // ContentDeleted: length only
+constexpr int64_t kKindFramed = 2;   // V1-framed bytes, verbatim range
+constexpr int64_t kKindUtf8 = 3;     // raw UTF-8 of a ContentString
+constexpr int64_t kKindSpill = 4;    // Python-realized (never produced here)
+constexpr int64_t kKindAnys = 5;     // `count` lib0 any values at [ofs,end)
+constexpr int64_t kKindJsons = 6;    // `count` ContentJSON var_strings
+constexpr int64_t kKindV2Lazy = 7;   // V2 embed/format/type byte ranges
+
+// error codes returned by ymx_prepare / ymx_ingest helpers
+constexpr int kErrMalformed = -1;    // bad bytes: caller retries via Python
+constexpr int kErrUnsupported = -9;  // subdocument: demote doc to CPU core
+constexpr int kErrLegacy = -4;       // payload kind the scanner won't carry
+constexpr int kErrInternal = -8;
+
+struct ContentDesc {
+  int64_t kind = kKindNone;
+  int64_t buf = kNull;
+  int64_t ofs = kNull, end = kNull;
+  int64_t ofs2 = kNull, end2 = kNull;
+  int64_t count = kNull;  // elements (ANYS/JSONS) or v2 type_ref (V2Lazy k7)
+  int64_t v2 = 0;         // source wire version (realize dispatch)
+};
+
+struct PendRef {
+  int64_t client = 0, clock = 0, length = 0;
+  int64_t oc = kNull, ok = 0;    // origin (client, clock); oc<0 = none
+  int64_t rc = kNull, rk = 0;    // rightOrigin
+  int64_t pic = kNull, pik = 0;  // parent type-item id
+  int64_t name_id = kNull;       // interned root-type name
+  int64_t sub_id = kNull;        // interned parentSub
+  int64_t ref = 0;               // wire content ref (0 = GC)
+  bool is_gc = false;
+  ContentDesc c;
+};
+
+struct Plan {
+  int64_t n_rows = 0;
+  std::vector<std::array<int64_t, 2>> splits;
+  std::vector<std::array<int64_t, 4>> sched;
+  std::vector<int64_t> delete_rows;
+  std::vector<std::array<int64_t, 3>> applied_ds;
+  std::vector<std::array<int64_t, 8>> sched8;
+  std::vector<int64_t> levels;
+  int64_t n_levels = 0;
+  int64_t max_width = 0;
+
+  void clear() {
+    n_rows = 0;
+    splits.clear();
+    sched.clear();
+    delete_rows.clear();
+    applied_ds.clear();
+    sched8.clear();
+    levels.clear();
+    n_levels = 0;
+    max_width = 0;
+  }
+};
+
+struct Mirror {
+  // client <-> dense slot mapping (creation order = Python slot())
+  std::vector<int64_t> client_of_slot;
+  std::unordered_map<int64_t, int64_t> slot_of_client;
+  // per-slot fragment index sorted by clock, and next expected clock
+  std::vector<std::vector<int64_t>> frag_clock, frag_row;
+  std::vector<int64_t> state;
+
+  // per-row columns
+  std::vector<int64_t> r_slot, r_clock, r_len;
+  std::vector<int64_t> r_oslot, r_oclock, r_rslot, r_rclock;
+  std::vector<int64_t> r_ref, r_seg;
+  std::vector<uint8_t> r_is_gc, r_countable;
+  std::vector<ContentDesc> r_c;
+  std::vector<uint8_t> r_host_deleted, r_lww_deleted;
+
+  // segment registry: (name_id, sub_id, parent_row) -> seg, creation order
+  std::map<std::tuple<int64_t, int64_t, int64_t>, int64_t> seg_lookup;
+  std::vector<int64_t> seg_name_id, seg_sub_id, seg_parent;
+  std::unordered_map<int64_t, std::vector<int64_t>> segs_of_parent;
+  std::unordered_map<int64_t, std::vector<int64_t>> rows_of_seg;  // nested only
+  std::unordered_map<int64_t, std::vector<int64_t>> map_chain;
+
+  // interned strings (UTF-8 blob + ranges); key = raw bytes
+  std::vector<uint8_t> strings;
+  std::unordered_map<std::string, int64_t> interned;
+  std::vector<int64_t> intern_ofs, intern_len;
+
+  // delete-set bookkeeping: per-slot ranges + slot first-note order
+  std::unordered_map<int64_t, std::vector<std::array<int64_t, 2>>> ds;
+  std::vector<int64_t> ds_slot_order;
+
+  // pending causally-early refs per client + pending delete ranges
+  std::map<int64_t, std::vector<PendRef>> pending;
+  std::vector<std::array<int64_t, 3>> pending_ds;
+
+  // buffer registry: borrowed update bytes + owned arena blocks
+  std::vector<std::pair<const uint8_t*, uint64_t>> bufs;
+  std::vector<std::unique_ptr<std::vector<uint8_t>>> owned;
+
+  Plan plan;
+  uint64_t gen = 0;
+
+  // ---- interning / slots / segments -------------------------------------
+
+  int64_t intern(const uint8_t* p, int64_t n) {
+    std::string key(reinterpret_cast<const char*>(p), (size_t)n);
+    auto it = interned.find(key);
+    if (it != interned.end()) return it->second;
+    int64_t id = (int64_t)intern_ofs.size();
+    intern_ofs.push_back((int64_t)strings.size());
+    intern_len.push_back(n);
+    strings.insert(strings.end(), p, p + n);
+    interned.emplace(std::move(key), id);
+    return id;
+  }
+
+  int64_t slot(int64_t client) {
+    auto it = slot_of_client.find(client);
+    if (it != slot_of_client.end()) return it->second;
+    int64_t s = (int64_t)client_of_slot.size();
+    slot_of_client.emplace(client, s);
+    client_of_slot.push_back(client);
+    frag_clock.emplace_back();
+    frag_row.emplace_back();
+    state.push_back(0);
+    return s;
+  }
+
+  int64_t get_state(int64_t client) const {
+    auto it = slot_of_client.find(client);
+    return it == slot_of_client.end() ? 0 : state[it->second];
+  }
+
+  int64_t n_rows() const { return (int64_t)r_slot.size(); }
+  int64_t n_segs() const { return (int64_t)seg_name_id.size(); }
+  bool seg_is_map(int64_t s) const { return seg_sub_id[s] != kNull; }
+
+  int64_t seg(int64_t name_id, int64_t sub_id, int64_t parent_row) {
+    auto key = std::make_tuple(name_id, sub_id, parent_row);
+    auto it = seg_lookup.find(key);
+    if (it != seg_lookup.end()) return it->second;
+    int64_t s = n_segs();
+    seg_lookup.emplace(key, s);
+    seg_name_id.push_back(name_id);
+    seg_sub_id.push_back(sub_id);
+    seg_parent.push_back(parent_row);
+    if (parent_row != kNull) segs_of_parent[parent_row].push_back(s);
+    return s;
+  }
+
+  // ---- buffers / arena ---------------------------------------------------
+
+  int64_t add_buf(const uint8_t* p, uint64_t n) {
+    bufs.emplace_back(p, n);
+    return (int64_t)bufs.size() - 1;
+  }
+
+  // synthesize an owned buffer (surrogate repairs, compaction merges)
+  int64_t arena(std::vector<uint8_t>&& data) {
+    owned.push_back(std::make_unique<std::vector<uint8_t>>(std::move(data)));
+    auto& v = *owned.back();
+    bufs.emplace_back(v.data(), (uint64_t)v.size());
+    return (int64_t)bufs.size() - 1;
+  }
+
+  const uint8_t* buf_ptr(int64_t b) const { return bufs[(size_t)b].first; }
+  uint64_t buf_len(int64_t b) const { return bufs[(size_t)b].second; }
+
+  // ---- content descriptor splitting -------------------------------------
+
+  // byte index of UTF-16 unit `units` within the UTF-8 range; *mid_pair set
+  // when the cut lands between the two units of a 4-byte char (the char is
+  // consumed; reference ContentString.js:51-66 replaces both halves)
+  static uint64_t utf8_at_u16(const uint8_t* b, uint64_t ofs, uint64_t end,
+                              int64_t units, bool* mid_pair) {
+    uint64_t i = ofs;
+    int64_t got = 0;
+    *mid_pair = false;
+    while (got < units && i < end) {
+      uint8_t c = b[i];
+      if (c < 0x80) { got += 1; i += 1; }
+      else if (c < 0xE0) { got += 1; i += 2; }
+      else if (c < 0xF0) { got += 1; i += 3; }
+      else {
+        if (got + 2 <= units) { got += 2; i += 4; }
+        else { got += 2; i += 4; *mid_pair = true; }
+      }
+    }
+    return i;
+  }
+
+  // advance an element-range descriptor past `k` elements; returns new ofs
+  int64_t elem_skip(const ContentDesc& c, int64_t k) const {
+    Reader r{buf_ptr(c.buf), (uint64_t)c.end, (uint64_t)c.ofs, false};
+    for (int64_t i = 0; i < k && !r.fail; i++) {
+      if (c.kind == kKindAnys) r.skip_any();
+      else { uint64_t o, b; r.var_string(&o, &b); }
+    }
+    return r.fail ? kNull : (int64_t)r.pos;
+  }
+
+  // split `c` (a row/ref of total length `total`) at element offset `off`:
+  // `c` keeps the left part, the returned descriptor is the right part.
+  // ok=false on malformed content (caller degrades to Python).
+  ContentDesc desc_split(ContentDesc& c, int64_t total, int64_t off, bool* ok) {
+    *ok = true;
+    ContentDesc right = c;
+    switch (c.kind) {
+      case kKindDeleted:
+        return right;  // length-only content: columns carry the lengths
+      case kKindAnys:
+      case kKindJsons: {
+        int64_t cut = elem_skip(c, off);
+        if (cut == kNull) { *ok = false; return right; }
+        right = c;
+        right.ofs = cut;
+        right.count = c.count - off;
+        c.end = cut;
+        c.count = off;
+        return right;
+      }
+      case kKindUtf8: {
+        bool mid = false;
+        uint64_t cut = utf8_at_u16(buf_ptr(c.buf), (uint64_t)c.ofs,
+                                   (uint64_t)c.end, off, &mid);
+        if (cut > (uint64_t)c.end) {  // truncated trailing sequence
+          *ok = false;
+          return right;
+        }
+        if (!mid) {
+          right = c;
+          right.ofs = (int64_t)cut;
+          c.end = (int64_t)cut;
+          return right;
+        }
+        // the cut consumed a surrogate pair: left = prefix + U+FFFD,
+        // right = U+FFFD + suffix (both synthesized into arena buffers)
+        std::vector<uint8_t> lbytes(buf_ptr(c.buf) + c.ofs,
+                                    buf_ptr(c.buf) + (cut - 4));
+        lbytes.insert(lbytes.end(), {0xEF, 0xBF, 0xBD});
+        std::vector<uint8_t> rbytes{0xEF, 0xBF, 0xBD};
+        rbytes.insert(rbytes.end(), buf_ptr(c.buf) + cut,
+                      buf_ptr(c.buf) + c.end);
+        int64_t lb = arena(std::move(lbytes));
+        int64_t rb = arena(std::move(rbytes));
+        c.buf = lb; c.ofs = 0; c.end = (int64_t)buf_len(lb);
+        right.kind = kKindUtf8;
+        right.buf = rb; right.ofs = 0; right.end = (int64_t)buf_len(rb);
+        right.v2 = c.v2;
+        return right;
+      }
+      default:
+        *ok = false;  // V2Lazy/Spill/None are length-1 or unsplittable
+        return right;
+    }
+  }
+
+  bool desc_trim_left(ContentDesc* c, int64_t total, int64_t off) {
+    bool ok = true;
+    ContentDesc right = desc_split(*c, total, off, &ok);
+    if (ok) *c = right;
+    return ok;
+  }
+
+  // ---- row / fragment bookkeeping (DocMirror._add_row etc.) -------------
+
+  void note_deleted(int64_t slot_, int64_t clock, int64_t len) {
+    auto it = ds.find(slot_);
+    if (it == ds.end()) {
+      ds_slot_order.push_back(slot_);
+      ds[slot_].push_back({{clock, len}});
+    } else {
+      it->second.push_back({{clock, len}});
+    }
+  }
+
+  int64_t add_row(int64_t slot_, int64_t clock, int64_t length,
+                  int64_t oc, int64_t ok_, int64_t rc, int64_t rk,
+                  bool is_gc, const ContentDesc& c, int64_t ref,
+                  int64_t seg_) {
+    int64_t row = n_rows();
+    r_slot.push_back(slot_);
+    r_clock.push_back(clock);
+    r_len.push_back(length);
+    if (oc < 0) { r_oslot.push_back(kNull); r_oclock.push_back(0); }
+    else { r_oslot.push_back(slot(oc)); r_oclock.push_back(ok_); }
+    if (rc < 0) { r_rslot.push_back(kNull); r_rclock.push_back(0); }
+    else { r_rslot.push_back(slot(rc)); r_rclock.push_back(rk); }
+    r_is_gc.push_back(is_gc ? 1 : 0);
+    r_countable.push_back((!is_gc && ref != 0 && ref != 1 && ref != 6) ? 1 : 0);
+    r_c.push_back(c);
+    r_ref.push_back(ref);
+    r_seg.push_back(is_gc ? kNull : seg_);
+    r_host_deleted.push_back(0);
+    r_lww_deleted.push_back(0);
+    if (!is_gc && seg_ != kNull && seg_parent[seg_] != kNull)
+      rows_of_seg[seg_].push_back(row);
+    gen++;
+    if (is_gc) note_deleted(slot_, clock, length);
+    auto& fc = frag_clock[slot_];
+    auto& fr = frag_row[slot_];
+    if (fc.empty() || clock > fc.back()) {
+      fc.push_back(clock);
+      fr.push_back(row);
+    } else {
+      auto it = std::lower_bound(fc.begin(), fc.end(), clock);
+      size_t i = (size_t)(it - fc.begin());
+      fc.insert(fc.begin() + i, clock);
+      fr.insert(fr.begin() + i, row);
+    }
+    int64_t end = clock + length;
+    if (end > state[slot_]) state[slot_] = end;
+    return row;
+  }
+
+  // index into the frag lists of the fragment covering `clock`, or -1
+  int64_t frag_containing(int64_t slot_, int64_t clock) const {
+    const auto& fc = frag_clock[slot_];
+    auto it = std::upper_bound(fc.begin(), fc.end(), clock);
+    int64_t i = (int64_t)(it - fc.begin()) - 1;
+    if (i < 0) return kNull;
+    int64_t row = frag_row[slot_][(size_t)i];
+    if (clock < r_clock[row] + r_len[row]) return i;
+    return kNull;
+  }
+
+  int64_t split_existing(int64_t slot_, int64_t frag_idx, int64_t at_clock,
+                         bool* ok) {
+    int64_t row = frag_row[slot_][(size_t)frag_idx];
+    int64_t offset = at_clock - r_clock[row];
+    ContentDesc right = desc_split(r_c[row], r_len[row], offset, ok);
+    if (!*ok) return kNull;
+    gen++;
+    int64_t sg = r_seg[row];
+    int64_t rslt = r_rslot[row] == kNull ? kNull
+                   : client_of_slot[r_rslot[row]];
+    int64_t new_row = add_row(
+        slot_, at_clock, r_len[row] - offset,
+        client_of_slot[slot_], at_clock - 1, rslt, r_rclock[row],
+        false, right, r_ref[row], sg);
+    r_len[row] = offset;
+    plan.splits.push_back({{row, new_row}});
+    if (r_host_deleted[row]) r_host_deleted[new_row] = 1;
+    if (sg != kNull && seg_is_map(sg)) {
+      auto& chain = map_chain[sg];
+      auto it = std::find(chain.begin(), chain.end(), row);
+      chain.insert(it + 1, new_row);
+      if (r_lww_deleted[row]) r_lww_deleted[new_row] = 1;
+    }
+    return new_row;
+  }
+
+  // ---- map-chain YATA insert (DocMirror._chain_insert) ------------------
+
+  int64_t origin_row_of(int64_t row) const {
+    int64_t s = r_oslot[row];
+    if (s == kNull) return kNull;
+    int64_t fi = frag_containing(s, r_oclock[row]);
+    return fi == kNull ? kNull : frag_row[s][(size_t)fi];
+  }
+
+  bool row_origin_eq(int64_t a, int64_t b) const {
+    int64_t sa = r_oslot[a], sb = r_oslot[b];
+    return sa == sb && (sa == kNull || r_oclock[a] == r_oclock[b]);
+  }
+
+  bool row_right_eq(int64_t a, int64_t b) const {
+    int64_t sa = r_rslot[a], sb = r_rslot[b];
+    return sa == sb && (sa == kNull || r_rclock[a] == r_rclock[b]);
+  }
+
+  int64_t row_client(int64_t row) const {
+    return client_of_slot[r_slot[row]];
+  }
+
+  void chain_insert(int64_t sg, int64_t row, int64_t left_row,
+                    int64_t right_row) {
+    auto& chain = map_chain[sg];
+    int64_t li = -1;
+    if (left_row != kNull) {
+      auto it = std::find(chain.begin(), chain.end(), left_row);
+      li = (int64_t)(it - chain.begin());
+    }
+    std::set<int64_t> items_before, conflicting;
+    int64_t left_i = li;
+    int64_t i = li + 1;
+    while (i < (int64_t)chain.size()) {
+      int64_t o = chain[(size_t)i];
+      if (o == right_row) break;
+      items_before.insert(o);
+      conflicting.insert(o);
+      if (row_origin_eq(row, o)) {
+        if (row_client(o) < row_client(row)) {
+          left_i = i;
+          conflicting.clear();
+        } else if (row_right_eq(row, o)) {
+          break;
+        }
+      } else {
+        int64_t oor = origin_row_of(o);
+        if (oor != kNull && items_before.count(oor)) {
+          if (!conflicting.count(oor)) {
+            left_i = i;
+            conflicting.clear();
+          }
+        } else {
+          break;
+        }
+      }
+      i++;
+    }
+    chain.insert(chain.begin() + (size_t)(left_i + 1), row);
+  }
+
+  // ---- deletes (DocMirror._delete_row / _lww_pass) ----------------------
+
+  void delete_row(int64_t row) {
+    if (r_host_deleted[row] || r_is_gc[row]) return;
+    r_host_deleted[row] = 1;
+    plan.delete_rows.push_back(row);
+    note_deleted(r_slot[row], r_clock[row], r_len[row]);
+    plan.applied_ds.push_back({{row_client(row), r_clock[row], r_len[row]}});
+    int64_t sg = r_seg[row];
+    if (sg != kNull && seg_is_map(sg)) r_lww_deleted[row] = 1;
+    if (r_ref[row] == 7) {
+      auto it = segs_of_parent.find(row);
+      if (it != segs_of_parent.end()) {
+        for (int64_t cs : it->second) {
+          auto rit = rows_of_seg.find(cs);
+          if (rit == rows_of_seg.end()) continue;
+          std::vector<int64_t> children = rit->second;  // copy: recursion mutates
+          for (int64_t child : children) delete_row(child);
+        }
+      }
+    }
+  }
+
+  void lww_pass(const std::vector<int64_t>& segs) {
+    for (int64_t sg : segs) {
+      auto it = map_chain.find(sg);
+      if (it == map_chain.end() || it->second.empty()) continue;
+      int64_t tail = it->second.back();
+      for (int64_t r : it->second)
+        if (r != tail && !r_lww_deleted[r]) delete_row(r);
+    }
+  }
+
+  // ---- wire scan (decode_update_refs twin) ------------------------------
+
+  // scan one update into `out`; returns 0 or an error code
+  int scan_update(int64_t buf_id, bool v2, std::vector<PendRef>* out,
+                  std::vector<std::array<int64_t, 3>>* ds_out) {
+    const uint8_t* buf = buf_ptr(buf_id);
+    uint64_t blen = buf_len(buf_id);
+    if (!v2) return scan_v1(buf, blen, buf_id, out, ds_out);
+    return scan_v2(buf, blen, buf_id, out, ds_out);
+  }
+
+  int scan_v1(const uint8_t* buf, uint64_t blen, int64_t buf_id,
+              std::vector<PendRef>* out,
+              std::vector<std::array<int64_t, 3>>* ds_out) {
+    Reader r{buf, blen, 0, false};
+    uint64_t n_updates = r.varuint();
+    for (uint64_t u = 0; u < n_updates && !r.fail; u++) {
+      uint64_t n_structs = r.varuint();
+      uint64_t client = r.varuint();
+      uint64_t clock = r.varuint();
+      for (uint64_t s = 0; s < n_structs && !r.fail; s++) {
+        PendRef p;
+        p.client = (int64_t)client;
+        p.clock = (int64_t)clock;
+        uint8_t info = r.u8();
+        uint8_t ref = info & kBits5;
+        p.ref = ref;
+        if (ref == 0) {
+          p.is_gc = true;
+          p.length = (int64_t)r.varuint();
+          p.c.kind = kKindNone;
+        } else {
+          if (ref == 9) return kErrUnsupported;  // ContentDoc: subdocument
+          if (info & kBit8) {
+            p.oc = (int64_t)r.varuint();
+            p.ok = (int64_t)r.varuint();
+          }
+          if (info & kBit7) {
+            p.rc = (int64_t)r.varuint();
+            p.rk = (int64_t)r.varuint();
+          }
+          if (!(info & (kBit7 | kBit8))) {
+            if (r.varuint() == 1) {
+              uint64_t o, b;
+              r.var_string(&o, &b);
+              if (r.fail) return kErrMalformed;
+              p.name_id = intern(buf + o, (int64_t)b);
+            } else {
+              p.pic = (int64_t)r.varuint();
+              p.pik = (int64_t)r.varuint();
+            }
+            if (info & kBit6) {
+              uint64_t o, b;
+              r.var_string(&o, &b);
+              if (r.fail) return kErrMalformed;
+              p.sub_id = intern(buf + o, (int64_t)b);
+            }
+          }
+          uint64_t c_ofs = r.pos;
+          switch (ref) {
+            case 1:
+              p.length = (int64_t)r.varuint();
+              p.c.kind = kKindDeleted;
+              break;
+            case 2: {  // ContentJSON: element range directly
+              uint64_t n = r.varuint();
+              uint64_t e_ofs = r.pos;
+              for (uint64_t i = 0; i < n && !r.fail; i++) {
+                uint64_t o, b;
+                r.var_string(&o, &b);
+              }
+              p.length = (int64_t)n;
+              p.c.kind = kKindJsons;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)e_ofs;
+              p.c.end = (int64_t)r.pos;
+              p.c.count = (int64_t)n;
+              break;
+            }
+            case 3: {
+              uint64_t n = r.varuint();
+              r.skip(n);
+              p.length = 1;
+              p.c.kind = kKindFramed;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)c_ofs;
+              p.c.end = (int64_t)r.pos;
+              break;
+            }
+            case 4: {  // ContentString: raw UTF-8 range
+              uint64_t o, b;
+              r.var_string(&o, &b);
+              p.length = (int64_t)r.utf16_len(o, b);
+              p.c.kind = kKindUtf8;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)o;
+              p.c.end = (int64_t)(o + b);
+              break;
+            }
+            case 5: case 6: {
+              uint64_t o, b;
+              r.var_string(&o, &b);
+              if (ref == 6) r.var_string(&o, &b);
+              p.length = 1;
+              p.c.kind = kKindFramed;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)c_ofs;
+              p.c.end = (int64_t)r.pos;
+              break;
+            }
+            case 7: {
+              uint64_t tref = r.varuint();
+              if (tref == 3 || tref == 5) {
+                uint64_t o, b;
+                r.var_string(&o, &b);
+              }
+              p.length = 1;
+              p.c.kind = kKindFramed;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)c_ofs;
+              p.c.end = (int64_t)r.pos;
+              break;
+            }
+            case 8: {  // ContentAny: element range directly
+              uint64_t n = r.varuint();
+              uint64_t e_ofs = r.pos;
+              for (uint64_t i = 0; i < n && !r.fail; i++) r.skip_any();
+              p.length = (int64_t)n;
+              p.c.kind = kKindAnys;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)e_ofs;
+              p.c.end = (int64_t)r.pos;
+              p.c.count = (int64_t)n;
+              break;
+            }
+            default:
+              return kErrMalformed;
+          }
+        }
+        if (r.fail) return kErrMalformed;
+        if (p.length == 0 && ref != 0) return kErrMalformed;
+        out->push_back(p);
+        clock += (uint64_t)p.length;
+      }
+    }
+    if (r.fail) return kErrMalformed;
+    uint64_t n_clients = r.varuint();
+    for (uint64_t c = 0; c < n_clients && !r.fail; c++) {
+      uint64_t client = r.varuint();
+      uint64_t n = r.varuint();
+      for (uint64_t i = 0; i < n && !r.fail; i++) {
+        uint64_t clock = r.varuint();
+        uint64_t len = r.varuint();
+        ds_out->push_back({{(int64_t)client, (int64_t)clock, (int64_t)len}});
+      }
+    }
+    if (r.fail || r.pos != blen) return kErrMalformed;
+    return 0;
+  }
+
+  int scan_v2(const uint8_t* buf, uint64_t blen, int64_t buf_id,
+              std::vector<PendRef>* out,
+              std::vector<std::array<int64_t, 3>>* ds_out) {
+    V2Streams v;
+    if (!v.init(buf, blen)) return kErrMalformed;
+    Reader* rest = &v.rest;
+    uint64_t n_updates = rest->varuint();
+    for (uint64_t u = 0; u < n_updates && !rest->fail; u++) {
+      uint64_t n_structs = rest->varuint();
+      int64_t client = v.client.read();
+      uint64_t clock = rest->varuint();
+      for (uint64_t s = 0; s < n_structs; s++) {
+        if (v.any_fail()) return kErrMalformed;
+        PendRef p;
+        p.client = client;
+        p.clock = (int64_t)clock;
+        p.c.v2 = 1;
+        uint8_t info = (uint8_t)v.info.read();
+        uint8_t ref = info & kBits5;
+        p.ref = ref;
+        if (ref == 0) {
+          p.is_gc = true;
+          p.length = v.len.read();
+          p.c.kind = kKindNone;
+        } else {
+          if (ref == 9) return kErrUnsupported;
+          if (ref == 2) return kErrLegacy;  // legacy ContentJSON in V2
+          if (info & kBit8) { p.oc = v.client.read(); p.ok = v.left_clock.read(); }
+          if (info & kBit7) { p.rc = v.client.read(); p.rk = v.right_clock.read(); }
+          if (!(info & (kBit7 | kBit8))) {
+            int64_t o = kNull, e = kNull;
+            if (v.parent_info.read() == 1) {
+              v.str.read(&o, &e);
+              if (v.any_fail()) return kErrMalformed;
+              p.name_id = intern(buf + o, e - o);
+            } else {
+              p.pic = v.client.read();
+              p.pik = v.left_clock.read();
+            }
+            if (info & kBit6) {
+              v.str.read(&o, &e);
+              if (v.any_fail()) return kErrMalformed;
+              p.sub_id = intern(buf + o, e - o);
+            }
+          }
+          switch (ref) {
+            case 1:
+              p.length = v.len.read();
+              p.c.kind = kKindDeleted;
+              break;
+            case 3: {
+              int64_t c_ofs = (int64_t)rest->pos;
+              uint64_t n = rest->varuint();
+              rest->skip(n);
+              p.length = 1;
+              p.c.kind = kKindFramed;  // varuint+bytes: V1-compatible framing
+              p.c.buf = buf_id;
+              p.c.ofs = c_ofs;
+              p.c.end = (int64_t)rest->pos;
+              break;
+            }
+            case 4: {
+              int64_t o, e;
+              v.str.read(&o, &e);
+              p.length = v.str.lens.s;
+              p.c.kind = kKindUtf8;
+              p.c.buf = buf_id;
+              p.c.ofs = o;
+              p.c.end = e;
+              break;
+            }
+            case 5: {  // embed: lib0 any (V2-only framing)
+              p.c.kind = kKindV2Lazy;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)rest->pos;
+              rest->skip_any();
+              p.c.end = (int64_t)rest->pos;
+              p.c.count = 5;
+              p.length = 1;
+              break;
+            }
+            case 6: {  // format: key string + any value
+              int64_t o, e;
+              v.str.read(&o, &e);
+              p.c.kind = kKindV2Lazy;
+              p.c.buf = buf_id;
+              p.c.ofs = o;
+              p.c.end = e;
+              p.c.ofs2 = (int64_t)rest->pos;
+              rest->skip_any();
+              p.c.end2 = (int64_t)rest->pos;
+              p.c.count = 6;
+              p.length = 1;
+              break;
+            }
+            case 7: {
+              int64_t tref = v.type_ref.read();
+              int64_t o = kNull, e = kNull;
+              if (tref == 3 || tref == 5) v.read_key(&o, &e);
+              p.c.kind = kKindV2Lazy;
+              p.c.buf = buf_id;
+              p.c.ofs = o;
+              p.c.end = e;
+              p.c.count = tref;  // type ref rides in count
+              p.length = 1;
+              break;
+            }
+            case 8: {
+              int64_t n = v.len.read();
+              p.c.kind = kKindAnys;
+              p.c.buf = buf_id;
+              p.c.ofs = (int64_t)rest->pos;
+              for (int64_t i = 0; i < n && !rest->fail; i++) rest->skip_any();
+              p.c.end = (int64_t)rest->pos;
+              p.c.count = n;
+              p.length = n;
+              break;
+            }
+            default:
+              return kErrMalformed;
+          }
+        }
+        if (v.any_fail()) return kErrMalformed;
+        if (p.length == 0 && ref != 0) return kErrMalformed;
+        out->push_back(p);
+        clock += (uint64_t)p.length;
+      }
+    }
+    if (rest->fail) return kErrMalformed;
+    // DS section: delta-varint clocks, len-1 on the wire
+    uint64_t n_clients = rest->varuint();
+    for (uint64_t c = 0; c < n_clients && !rest->fail; c++) {
+      int64_t cur = 0;
+      uint64_t client = rest->varuint();
+      uint64_t n = rest->varuint();
+      for (uint64_t i = 0; i < n && !rest->fail; i++) {
+        cur += (int64_t)rest->varuint();
+        int64_t clock = cur;
+        int64_t len = (int64_t)rest->varuint() + 1;
+        cur += len;
+        ds_out->push_back({{(int64_t)client, clock, len}});
+      }
+    }
+    if (rest->fail || rest->pos != blen) return kErrMalformed;
+    return 0;
+  }
+
+  // ---- the flush pipeline (DocMirror.prepare_step twin) -----------------
+
+  int prepare(const int64_t* buf_ids, const int64_t* v2_flags,
+              int64_t n_updates) {
+    const bool timing = std::getenv("YMX_TIMING") != nullptr;
+    auto t0 = std::chrono::steady_clock::now();
+    auto lap = [&](const char* what) {
+      if (!timing) return;
+      auto t1 = std::chrono::steady_clock::now();
+      std::fprintf(stderr, "[ymx] %-12s %8.1f us\n", what,
+                   std::chrono::duration<double, std::micro>(t1 - t0).count());
+      t0 = t1;
+    };
+    plan.clear();
+
+    // decode every staged update first (nothing merges on error; the doc
+    // demotes wholesale, matching the Python flow)
+    std::vector<std::pair<int64_t, std::vector<PendRef>>> incoming;  // client order
+    std::unordered_map<int64_t, size_t> incoming_idx;
+    std::vector<std::array<int64_t, 3>> ds_ranges(pending_ds);
+    {
+      std::vector<PendRef> refs;
+      std::vector<std::array<int64_t, 3>> ds_new;
+      for (int64_t i = 0; i < n_updates; i++) {
+        refs.clear();
+        std::vector<std::array<int64_t, 3>> ds_one;
+        int rc = scan_update(buf_ids[i], v2_flags[i] != 0, &refs, &ds_one);
+        if (rc != 0) return rc;
+        for (auto& p : refs) {
+          auto it = incoming_idx.find(p.client);
+          if (it == incoming_idx.end()) {
+            incoming_idx.emplace(p.client, incoming.size());
+            incoming.push_back({p.client, {p}});
+          } else {
+            incoming[it->second].second.push_back(p);
+          }
+        }
+        for (auto& d : ds_one) ds_new.push_back(d);
+      }
+      for (auto& d : ds_new) ds_ranges.push_back(d);
+    }
+    lap("scan");
+    pending_ds.clear();
+
+    // merge incoming into the pending queues, clock-sorted (stable)
+    for (auto& [client, rs] : incoming) {
+      auto& q = pending[client];
+      q.insert(q.end(), rs.begin(), rs.end());
+      std::stable_sort(q.begin(), q.end(),
+                       [](const PendRef& a, const PendRef& b) {
+                         return a.clock < b.clock;
+                       });
+    }
+
+    lap("merge");
+    // causal scheduling: per-client queue fixpoint, descending client order
+    std::vector<PendRef> sched;
+    std::unordered_map<int64_t, int64_t> overlay;
+    auto state_of = [&](int64_t client) {
+      auto it = overlay.find(client);
+      return it == overlay.end() ? get_state(client) : it->second;
+    };
+    auto dep_ok = [&](int64_t dc, int64_t dk, bool has, int64_t client) {
+      return !has || dc == client || state_of(dc) > dk;
+    };
+    // consumed-prefix head indexes (front erase on a vector of fat refs
+    // would be quadratic); prefixes are dropped once after the fixpoint
+    std::map<int64_t, size_t> q_head;
+    bool progress = true;
+    while (progress) {
+      progress = false;
+      for (auto it = pending.rbegin(); it != pending.rend(); ++it) {
+        auto& q = it->second;
+        int64_t client = it->first;
+        size_t& head = q_head[client];
+        while (head < q.size()) {
+          PendRef& ref = q[head];
+          int64_t st = state_of(client);
+          if (ref.clock > st) break;
+          if (ref.clock + ref.length <= st) {
+            head++;
+            progress = true;
+            continue;
+          }
+          if (!(dep_ok(ref.oc, ref.ok, ref.oc >= 0, client) &&
+                dep_ok(ref.rc, ref.rk, ref.rc >= 0, client) &&
+                dep_ok(ref.pic, ref.pik, ref.pic >= 0, client)))
+            break;
+          if (ref.clock < st) {
+            int64_t off = st - ref.clock;
+            if (!ref.is_gc) {
+              if (ref.c.kind != kKindNone &&
+                  !desc_trim_left(&ref.c, ref.length, off))
+                return kErrMalformed;
+            }
+            ref.clock += off;
+            ref.length -= off;
+            if (!ref.is_gc) {
+              ref.oc = ref.client;
+              ref.ok = ref.clock - 1;
+            }
+          }
+          sched.push_back(std::move(ref));
+          overlay[client] = sched.back().clock + sched.back().length;
+          head++;
+          progress = true;
+        }
+      }
+    }
+    for (auto it = pending.begin(); it != pending.end();) {
+      size_t head = q_head[it->first];
+      if (head > 0)
+        it->second.erase(it->second.begin(),
+                         it->second.begin() + (ptrdiff_t)head);
+      if (it->second.empty()) it = pending.erase(it);
+      else ++it;
+    }
+
+    lap("fixpoint");
+    // delete-set clamping against post-step state
+    std::vector<std::array<int64_t, 3>> applicable;
+    for (auto& [client, clock, ln] : ds_ranges) {
+      int64_t st = state_of(client);
+      if (clock < st)
+        applicable.push_back({{client, clock, std::min(ln, st - clock)}});
+      if (clock + ln > st) {
+        int64_t lo = std::max(clock, st);
+        pending_ds.push_back({{client, lo, clock + ln - lo}});
+      }
+    }
+
+    lap("ds-clamp");
+    // pre-split pass: every boundary this step needs (collected raw,
+    // then sorted+deduped per client — matches Python's set semantics
+    // without per-insert node allocation)
+    std::vector<int64_t> cut_clients;  // first-need order (Python dict order)
+    std::unordered_map<int64_t, std::vector<int64_t>> cuts;
+    cuts.reserve(16);
+    auto need_start = [&](int64_t client, int64_t clock) {
+      auto it = cuts.find(client);
+      if (it == cuts.end()) {
+        cut_clients.push_back(client);
+        cuts[client].push_back(clock);
+      } else {
+        it->second.push_back(clock);
+      }
+    };
+    for (auto& ref : sched) {
+      if (ref.oc >= 0) need_start(ref.oc, ref.ok + 1);
+      if (ref.rc >= 0) need_start(ref.rc, ref.rk);
+    }
+    for (auto& [client, clock, ln] : applicable) {
+      need_start(client, clock);
+      need_start(client, clock + ln);
+    }
+    for (auto& [client, ks] : cuts) {
+      std::sort(ks.begin(), ks.end());
+      ks.erase(std::unique(ks.begin(), ks.end()), ks.end());
+    }
+
+    lap("cuts");
+    // cuts inside scheduled refs: fragment the refs themselves.
+    // (Python walks per client over its sched indices; equivalent here:
+    // per ref, split by its own client's cut set — index order preserved
+    // because replacement happens in place per sched position.)
+    std::vector<PendRef> frag_sched;
+    frag_sched.reserve(sched.size());
+    for (auto& ref0 : sched) {
+      auto it = cuts.find(ref0.client);
+      if (it == cuts.end() || ref0.is_gc) {
+        frag_sched.push_back(ref0);
+        continue;
+      }
+      PendRef cur = ref0;
+      bool any = false;
+      auto& ks = it->second;
+      for (auto kit = std::upper_bound(ks.begin(), ks.end(), cur.clock);
+           kit != ks.end() && *kit < ref0.clock + ref0.length; ++kit) {
+        int64_t k = *kit;
+        if (k <= cur.clock) continue;
+        // split cur at k
+        PendRef right = cur;
+        int64_t off = k - cur.clock;
+        bool ok = true;
+        if (cur.c.kind != kKindNone) {
+          right.c = desc_split(cur.c, cur.length, off, &ok);
+          if (!ok) return kErrMalformed;
+        }
+        right.clock = cur.clock + off;
+        right.length = cur.length - off;
+        right.oc = cur.client;
+        right.ok = right.clock - 1;
+        cur.length = off;
+        frag_sched.push_back(cur);
+        cur = right;
+        any = true;
+      }
+      frag_sched.push_back(cur);
+      (void)any;
+    }
+
+    lap("frag-sched");
+    // cuts inside existing rows: split + device link surgery
+    size_t pre_split_marker = plan.splits.size();
+    for (int64_t client : cut_clients) {
+      auto sit = slot_of_client.find(client);
+      if (sit == slot_of_client.end()) continue;
+      int64_t slot_ = sit->second;
+      for (int64_t k : cuts[client]) {
+        int64_t fi = frag_containing(slot_, k);
+        if (fi == kNull) continue;
+        int64_t row = frag_row[slot_][(size_t)fi];
+        if (r_is_gc[row] || r_clock[row] == k) continue;
+        bool ok = true;
+        split_existing(slot_, fi, k, &ok);
+        if (!ok) return kErrMalformed;
+      }
+    }
+    std::sort(plan.splits.begin() + pre_split_marker, plan.splits.end(),
+              [](const std::array<int64_t, 2>& a,
+                 const std::array<int64_t, 2>& b) {
+                if (a[0] != b[0]) return a[0] < b[0];
+                return a[1] > b[1];
+              });
+
+    lap("pre-split");
+    // row assignment + pointer resolution
+    std::vector<int64_t> touched_map_segs;  // ascending on use (set below)
+    std::set<int64_t> touched_set;
+    for (auto& ref : frag_sched) {
+      int64_t slot_ = slot(ref.client);
+      if (ref.is_gc) {
+        add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
+                ContentDesc{}, 0, kNull);
+        continue;
+      }
+      int64_t left_row = kNull, right_row = kNull;
+      bool degrade = false;
+      if (ref.oc >= 0) {
+        int64_t oslot = slot(ref.oc);
+        int64_t fi = frag_containing(oslot, ref.ok);
+        if (fi == kNull) return kErrInternal;
+        left_row = frag_row[oslot][(size_t)fi];
+        if (r_is_gc[left_row]) degrade = true;
+      }
+      if (ref.rc >= 0) {
+        int64_t rslot = slot(ref.rc);
+        int64_t fi = frag_containing(rslot, ref.rk);
+        if (fi == kNull) return kErrInternal;
+        right_row = frag_row[rslot][(size_t)fi];
+        if (r_is_gc[right_row]) degrade = true;
+      }
+      int64_t parent_row = kNull;
+      if (!degrade && ref.pic >= 0) {
+        int64_t pslot = slot(ref.pic);
+        int64_t fi = frag_containing(pslot, ref.pik);
+        if (fi == kNull) return kErrInternal;
+        parent_row = frag_row[pslot][(size_t)fi];
+        if (r_is_gc[parent_row] || r_ref[parent_row] != 7) degrade = true;
+      }
+      if (degrade) {
+        add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
+                ContentDesc{}, 0, kNull);
+        continue;
+      }
+      int64_t sg;
+      if (parent_row != kNull) {
+        sg = seg(kNull, ref.sub_id, parent_row);
+      } else if (ref.name_id != kNull) {
+        sg = seg(ref.name_id, ref.sub_id, kNull);
+      } else if (left_row != kNull) {
+        sg = r_seg[left_row];
+      } else if (right_row != kNull) {
+        sg = r_seg[right_row];
+      } else {
+        return kErrUnsupported;  // item with no derivable parent
+      }
+      int64_t row = add_row(slot_, ref.clock, ref.length, ref.oc, ref.ok,
+                            ref.rc, ref.rk, false, ref.c, ref.ref, sg);
+      plan.sched.push_back({{row, left_row, right_row, sg}});
+      if (seg_is_map(sg)) {
+        chain_insert(sg, row, left_row, right_row);
+        if (touched_set.insert(sg).second) touched_map_segs.push_back(sg);
+      }
+      int64_t pr = seg_parent[sg];
+      if (pr != kNull && r_host_deleted[pr]) delete_row(row);
+      if (ref.ref == 1) applicable.push_back({{ref.client, ref.clock, ref.length}});
+    }
+
+    lap("rows");
+    // resolve delete ranges to row ids
+    for (size_t ai = 0; ai < applicable.size(); ai++) {
+      auto [client, clock, ln] = applicable[ai];
+      auto sit = slot_of_client.find(client);
+      if (sit == slot_of_client.end()) continue;
+      int64_t slot_ = sit->second;
+      auto& fc = frag_clock[slot_];
+      auto& fr = frag_row[slot_];
+      auto it = std::upper_bound(fc.begin(), fc.end(), clock);
+      int64_t i = (int64_t)(it - fc.begin()) - 1;
+      if (i < 0) i = 0;
+      int64_t end = clock + ln;
+      while (i < (int64_t)fc.size() && fc[(size_t)i] < end) {
+        if (fc[(size_t)i] >= clock) delete_row(fr[(size_t)i]);
+        i++;
+      }
+    }
+
+    lap("deletes");
+    // LWW: sorted seg order (delete order is consumer-order-independent)
+    std::sort(touched_map_segs.begin(), touched_map_segs.end());
+    lww_pass(touched_map_segs);
+    lap("lww");
+    plan.n_rows = n_rows();
+    assign_levels();
+    lap("levels");
+    gen++;
+    return 0;
+  }
+
+  // ---- level assignment (StepPlan.assign_levels twin) -------------------
+
+  void assign_levels() {
+    const bool timing = std::getenv("YMX_TIMING") != nullptr;
+    auto t0 = std::chrono::steady_clock::now();
+    auto lap = [&](const char* what) {
+      if (!timing) return;
+      auto t1 = std::chrono::steady_clock::now();
+      std::fprintf(stderr, "[ymx-lv] %-12s %8.1f us\n", what,
+                   std::chrono::duration<double, std::micro>(t1 - t0).count());
+      t0 = t1;
+    };
+    auto& sched = plan.sched;
+    size_t n = sched.size();
+    // group by (left, right, seg) preserving first-appearance order
+    struct Group {
+      int64_t left, right, seg;
+      std::vector<int64_t> members;  // row ids, sched order
+    };
+    std::vector<Group> groups;
+    groups.reserve(n);
+    std::unordered_map<uint64_t, std::vector<uint32_t>> gmap;  // hash -> idxs
+    gmap.reserve(n * 2);
+    auto ghash = [](int64_t l, int64_t r, int64_t s) -> uint64_t {
+      uint64_t h = 1469598103934665603ull;
+      for (uint64_t v : {(uint64_t)l, (uint64_t)r, (uint64_t)s}) {
+        h ^= v + 0x9e3779b97f4a7c15ull;
+        h *= 1099511628211ull;
+      }
+      return h;
+    };
+    for (size_t i = 0; i < n; i++) {
+      int64_t left = sched[i][1], right = sched[i][2], sg = sched[i][3];
+      auto& cands = gmap[ghash(left, right, sg)];
+      int32_t found = -1;
+      for (uint32_t gi : cands) {
+        Group& g = groups[gi];
+        if (g.left == left && g.right == right && g.seg == sg) {
+          found = (int32_t)gi;
+          break;
+        }
+      }
+      if (found < 0) {
+        cands.push_back((uint32_t)groups.size());
+        groups.push_back({left, right, sg, {sched[i][0]}});
+      } else {
+        groups[(size_t)found].members.push_back(sched[i][0]);
+      }
+    }
+    lap("grouping");
+    plan.sched8.clear();
+    plan.levels.clear();
+    plan.sched8.reserve(n);
+    plan.levels.reserve(n);
+    // row -> level scratch (0 = unassigned this pass)
+    std::vector<int64_t> lev_of_row((size_t)n_rows(), 0);
+    auto lev_of = [&](int64_t row) {
+      return (row >= 0 && row < (int64_t)lev_of_row.size())
+                 ? lev_of_row[(size_t)row]
+                 : 0;
+    };
+    // per-gap used levels (tiny sorted vectors; usually length 1)
+    std::unordered_map<int64_t, std::vector<int64_t>> used;
+    used.reserve(groups.size() * 2);
+    // open chain tails: tail row -> (entry idx, head check, head right, lev)
+    std::unordered_map<int64_t, std::array<int64_t, 4>> tails;
+    tails.reserve(groups.size() * 2);
+    int64_t n_levels = 0;
+    for (auto& g : groups) {
+      int64_t left = g.left, right = g.right, sg = g.seg;
+      auto& members = g.members;
+      if (members.size() > 1)
+        std::stable_sort(members.begin(), members.end(),
+                         [&](int64_t a, int64_t b) {
+                           return row_client(a) < row_client(b);
+                         });
+      auto tit = left != kNull ? tails.find(left) : tails.end();
+      if (tit != tails.end() && tit->second[2] == right &&
+          plan.sched8[(size_t)tit->second[0]][5] == sg) {
+        // stitch: continue the chain ending at `left` in place
+        auto [idx0, hchk, hr0, lev] = tit->second;
+        plan.sched8[(size_t)idx0][4] = members[0];
+        for (size_t j = 0; j < members.size(); j++) {
+          int64_t row = members[j];
+          int64_t succ = j + 1 < members.size() ? members[j + 1] : kGatherSucc;
+          plan.sched8.push_back(
+              {{row, kNoLeftWrite, hr0, hchk, succ, sg, left, right}});
+          plan.levels.push_back(lev);
+          lev_of_row[(size_t)row] = lev;
+        }
+        tails.erase(left);
+        tails[members.back()] = {(int64_t)plan.sched8.size() - 1, hchk, hr0,
+                                 lev};
+        continue;
+      }
+      int64_t base = 1 + std::max(lev_of(left), lev_of(right));
+      int64_t gap = left != kNull ? left : ~sg;  // head writes keyed per seg
+      int64_t lev = base;
+      {
+        auto& lvls = used[gap];
+        auto it = std::lower_bound(lvls.begin(), lvls.end(), lev);
+        while (it != lvls.end() && *it == lev) {
+          ++lev;
+          ++it;
+        }
+        lvls.insert(it, lev);
+      }
+      for (size_t j = 0; j < members.size(); j++) {
+        int64_t row = members[j];
+        int64_t entry_left = j == 0 ? left : kNoLeftWrite;
+        int64_t succ = j + 1 < members.size() ? members[j + 1] : kGatherSucc;
+        plan.sched8.push_back(
+            {{row, entry_left, right, left, succ, sg, left, right}});
+        plan.levels.push_back(lev);
+        lev_of_row[(size_t)row] = lev;
+      }
+      tails[members.back()] = {(int64_t)plan.sched8.size() - 1, left, right,
+                               lev};
+      n_levels = std::max(n_levels, lev);
+    }
+    lap("main-loop");
+    plan.n_levels = n_levels;
+    // width of the widest level (for the engine's padded pack)
+    std::vector<int64_t> width((size_t)n_levels, 0);
+    for (int64_t lv : plan.levels) width[(size_t)(lv - 1)]++;
+    plan.max_width = 0;
+    for (int64_t w : width) plan.max_width = std::max(plan.max_width, w);
+  }
+
+  // ---- compaction (DocMirror.rebuild_compacted twin) --------------------
+
+  // merge content descriptors of rows a,b; returns false when not mergeable
+  bool desc_merge(int64_t a, int64_t b) {
+    ContentDesc& ca = r_c[(size_t)a];
+    ContentDesc& cb = r_c[(size_t)b];
+    if (ca.kind != cb.kind) return false;
+    switch (ca.kind) {
+      case kKindDeleted:
+        return true;
+      case kKindUtf8:
+      case kKindAnys:
+      case kKindJsons: {
+        if (ca.kind != kKindUtf8 && ca.v2 != cb.v2) return false;
+        if (ca.buf == cb.buf && ca.end == cb.ofs) {
+          ca.end = cb.end;  // naturally adjacent: extend in place
+        } else {
+          std::vector<uint8_t> merged(buf_ptr(ca.buf) + ca.ofs,
+                                      buf_ptr(ca.buf) + ca.end);
+          merged.insert(merged.end(), buf_ptr(cb.buf) + cb.ofs,
+                        buf_ptr(cb.buf) + cb.end);
+          int64_t nb = arena(std::move(merged));
+          ca.buf = nb;
+          ca.ofs = 0;
+          ca.end = (int64_t)buf_len(nb);
+        }
+        if (ca.kind != kKindUtf8) ca.count += cb.count;
+        return true;
+      }
+      default:
+        return false;  // Framed/V2Lazy: length-1 kinds never merge
+    }
+  }
+
+  bool try_merge(int64_t a, int64_t b, const uint8_t* deleted) {
+    if (r_slot[a] != r_slot[b]) return false;
+    if (r_clock[a] + r_len[a] != r_clock[b]) return false;
+    if ((deleted[a] != 0) != (deleted[b] != 0)) return false;
+    if (r_is_gc[a] != r_is_gc[b]) return false;
+    if (segs_of_parent.count(a) || segs_of_parent.count(b)) return false;
+    if (r_is_gc[a]) return true;
+    if (r_oslot[b] != r_slot[a] ||
+        r_oclock[b] != r_clock[a] + r_len[a] - 1)
+      return false;
+    if (!row_right_eq(a, b)) return false;
+    if (r_ref[a] != r_ref[b]) return false;
+    return desc_merge(a, b);
+  }
+
+  // renumber every host structure after compaction decided `keep`
+  void renumber(const std::vector<int64_t>& keep,
+                const std::vector<int64_t>& new_of_old) {
+    auto take_i = [&](std::vector<int64_t>& col) {
+      std::vector<int64_t> out;
+      out.reserve(keep.size());
+      for (int64_t r : keep) out.push_back(col[(size_t)r]);
+      col = std::move(out);
+    };
+    auto take_b = [&](std::vector<uint8_t>& col) {
+      std::vector<uint8_t> out;
+      out.reserve(keep.size());
+      for (int64_t r : keep) out.push_back(col[(size_t)r]);
+      col = std::move(out);
+    };
+    take_i(r_slot); take_i(r_clock); take_i(r_len);
+    take_i(r_oslot); take_i(r_oclock); take_i(r_rslot); take_i(r_rclock);
+    take_i(r_ref); take_i(r_seg);
+    take_b(r_is_gc); take_b(r_countable);
+    take_b(r_host_deleted); take_b(r_lww_deleted);
+    {
+      std::vector<ContentDesc> out;
+      out.reserve(keep.size());
+      for (int64_t r : keep) out.push_back(r_c[(size_t)r]);
+      r_c = std::move(out);
+    }
+    gen++;
+    // fragment index: rebuild clock-sorted per slot
+    size_t n_slots = client_of_slot.size();
+    for (size_t s = 0; s < n_slots; s++) {
+      frag_clock[s].clear();
+      frag_row[s].clear();
+    }
+    std::vector<std::vector<int64_t>> by_slot(n_slots);
+    for (size_t row = 0; row < r_slot.size(); row++)
+      by_slot[(size_t)r_slot[row]].push_back((int64_t)row);
+    for (size_t s = 0; s < n_slots; s++) {
+      auto& rows = by_slot[s];
+      std::sort(rows.begin(), rows.end(), [&](int64_t a, int64_t b) {
+        return r_clock[a] < r_clock[b];
+      });
+      for (int64_t r : rows) {
+        frag_clock[s].push_back(r_clock[r]);
+        frag_row[s].push_back(r);
+      }
+    }
+    // map chains / nested bookkeeping
+    for (auto& [sg, chain] : map_chain) {
+      std::vector<int64_t> out;
+      for (int64_t r : chain)
+        if (new_of_old[(size_t)r] != kNull)
+          out.push_back(new_of_old[(size_t)r]);
+      chain = std::move(out);
+    }
+    {
+      std::unordered_map<int64_t, std::vector<int64_t>> out;
+      for (auto& [sg, rows] : rows_of_seg) {
+        std::vector<int64_t> nr;
+        for (int64_t r : rows)
+          if (new_of_old[(size_t)r] != kNull)
+            nr.push_back(new_of_old[(size_t)r]);
+        out[sg] = std::move(nr);
+      }
+      rows_of_seg = std::move(out);
+    }
+    {
+      // seg parents renumber (type rows never merge, so they survive)
+      std::map<std::tuple<int64_t, int64_t, int64_t>, int64_t> lookup;
+      std::unordered_map<int64_t, std::vector<int64_t>> parents;
+      for (int64_t s = 0; s < n_segs(); s++) {
+        if (seg_parent[s] != kNull)
+          seg_parent[s] = new_of_old[(size_t)seg_parent[s]];
+        lookup[std::make_tuple(seg_name_id[s], seg_sub_id[s],
+                               seg_parent[s])] = s;
+        if (seg_parent[s] != kNull) parents[seg_parent[s]].push_back(s);
+      }
+      seg_lookup = std::move(lookup);
+      segs_of_parent = std::move(parents);
+    }
+    // compact DS ranges (sorted union per slot)
+    for (auto& [slot_, ranges] : ds) {
+      std::sort(ranges.begin(), ranges.end());
+      std::vector<std::array<int64_t, 2>> out;
+      for (auto& [clock, ln] : ranges) {
+        if (!out.empty() && clock <= out.back()[0] + out.back()[1]) {
+          out.back()[1] =
+              std::max(out.back()[1], clock + ln - out.back()[0]);
+        } else {
+          out.push_back({{clock, ln}});
+        }
+      }
+      ranges = std::move(out);
+    }
+  }
+
+  // full compaction entry: device read-back in, renumbered device state out
+  int64_t compact(const int32_t* right_link, const uint8_t* deleted,
+                  const int32_t* heads, int64_t n_heads, int gc,
+                  int32_t* new_right, uint8_t* new_deleted,
+                  int32_t* new_heads, int64_t new_heads_cap) {
+    int64_t n = n_rows();
+    // per-seg order from the read-back links
+    std::vector<std::vector<int64_t>> order_of_seg((size_t)n_segs());
+    for (int64_t sg = 0; sg < n_segs(); sg++) {
+      int64_t head = sg < n_heads ? heads[sg] : kNull;
+      int64_t r = head;
+      while (r != kNull) {
+        order_of_seg[(size_t)sg].push_back(r);
+        r = right_link[r];
+      }
+    }
+    if (gc) {
+      for (int64_t row = 0; row < n; row++) {
+        if (!r_is_gc[row] && deleted[row] && r_ref[row] != 1) {
+          r_c[(size_t)row] = ContentDesc{};
+          r_c[(size_t)row].kind = kKindDeleted;
+          r_ref[row] = 1;
+          r_countable[row] = 0;
+        }
+      }
+    }
+    std::unordered_map<int64_t, int64_t> absorbed;
+    for (int64_t sg = 0; sg < n_segs(); sg++) {
+      if (seg_is_map(sg)) continue;
+      auto& order = order_of_seg[(size_t)sg];
+      size_t i = 0;
+      while (i + 1 < order.size()) {
+        int64_t a = order[i], b = order[i + 1];
+        if (try_merge(a, b, deleted)) {
+          r_len[a] += r_len[b];
+          absorbed[b] = a;
+          order.erase(order.begin() + (ptrdiff_t)(i + 1));
+        } else {
+          i++;
+        }
+      }
+    }
+    // GC structs: merge contiguous runs per client (not in any list)
+    for (size_t s = 0; s < client_of_slot.size(); s++) {
+      int64_t prev = kNull;
+      for (int64_t row : frag_row[s]) {
+        if (!r_is_gc[row] || absorbed.count(row)) {
+          prev = r_is_gc[row] ? row : kNull;
+          continue;
+        }
+        if (prev != kNull && try_merge(prev, row, deleted)) {
+          r_len[prev] += r_len[row];
+          absorbed[row] = prev;
+        } else {
+          prev = row;
+        }
+      }
+    }
+    std::vector<int64_t> new_of_old((size_t)n, kNull);
+    std::vector<int64_t> keep;
+    keep.reserve((size_t)n);
+    for (int64_t r = 0; r < n; r++) {
+      if (!absorbed.count(r)) {
+        new_of_old[(size_t)r] = (int64_t)keep.size();
+        keep.push_back(r);
+      }
+    }
+    renumber(keep, new_of_old);
+    int64_t n_new = (int64_t)keep.size();
+    for (int64_t r = 0; r < n_new; r++) {
+      new_right[r] = (int32_t)kNull;
+      new_deleted[r] = deleted[keep[(size_t)r]];
+    }
+    for (int64_t sg = 0; sg < std::min(new_heads_cap, n_segs()); sg++)
+      new_heads[sg] = (int32_t)kNull;
+    for (int64_t sg = 0; sg < n_segs(); sg++) {
+      int64_t prev = kNull;
+      for (int64_t old : order_of_seg[(size_t)sg]) {
+        int64_t nr = new_of_old[(size_t)old];
+        if (prev == kNull) {
+          if (sg < new_heads_cap) new_heads[sg] = (int32_t)nr;
+        } else {
+          new_right[prev] = (int32_t)nr;
+        }
+        prev = nr;
+      }
+    }
+    return n_new;
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* ymx_new() { return new Mirror(); }
+void ymx_free(void* h) { delete static_cast<Mirror*>(h); }
+
+int64_t ymx_add_buf(void* h, const uint8_t* p, uint64_t n) {
+  return static_cast<Mirror*>(h)->add_buf(p, n);
+}
+
+int64_t ymx_n_bufs(void* h) {
+  return (int64_t)static_cast<Mirror*>(h)->bufs.size();
+}
+
+// roll back buffer registrations from a failed scan (nothing referenced
+// them: scan failures happen before any ref merges; arena chunks are only
+// created by later phases, so the tail is exactly the staged updates)
+void ymx_drop_bufs_from(void* h, int64_t first) {
+  Mirror* m = static_cast<Mirror*>(h);
+  if (first >= 0 && (size_t)first < m->bufs.size())
+    m->bufs.resize((size_t)first);
+}
+
+int64_t ymx_buf_len(void* h, int64_t idx) {
+  Mirror* m = static_cast<Mirror*>(h);
+  if (idx < 0 || (size_t)idx >= m->bufs.size()) return -1;
+  return (int64_t)m->buf_len(idx);
+}
+
+// run the flush pipeline over the staged updates (buf ids + v2 flags).
+// out_counts (int64[12]): n_rows, n_splits, n_sched, n_sched8, n_levels,
+// max_width, n_delete_rows, n_applied_ds, has_pending, pending_depth,
+// n_slots, n_segs.  Returns 0 or an error code (<0).
+int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
+                int64_t n_updates, int64_t* out_counts) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int rc = m->prepare(buf_ids, v2_flags, n_updates);
+  if (rc != 0) return rc;
+  int64_t depth = (int64_t)m->pending_ds.size();
+  for (auto& [c, q] : m->pending) depth += (int64_t)q.size();
+  out_counts[0] = m->plan.n_rows;
+  out_counts[1] = (int64_t)m->plan.splits.size();
+  out_counts[2] = (int64_t)m->plan.sched.size();
+  out_counts[3] = (int64_t)m->plan.sched8.size();
+  out_counts[4] = m->plan.n_levels;
+  out_counts[5] = m->plan.max_width;
+  out_counts[6] = (int64_t)m->plan.delete_rows.size();
+  out_counts[7] = (int64_t)m->plan.applied_ds.size();
+  out_counts[8] = (m->pending.empty() && m->pending_ds.empty()) ? 0 : 1;
+  out_counts[9] = depth;
+  out_counts[10] = (int64_t)m->client_of_slot.size();
+  out_counts[11] = m->n_segs();
+  return 0;
+}
+
+void ymx_plan_splits(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (auto& s : m->plan.splits) { *out++ = s[0]; *out++ = s[1]; }
+}
+
+void ymx_plan_sched(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (auto& s : m->plan.sched)
+    for (int i = 0; i < 4; i++) *out++ = s[i];
+}
+
+void ymx_plan_sched8(void* h, int64_t* out8, int64_t* out_lv) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (auto& s : m->plan.sched8)
+    for (int i = 0; i < 8; i++) *out8++ = s[i];
+  for (int64_t lv : m->plan.levels) *out_lv++ = lv;
+}
+
+void ymx_plan_deletes(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (int64_t r : m->plan.delete_rows) *out++ = r;
+}
+
+void ymx_plan_applied_ds(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (auto& d : m->plan.applied_ds) { *out++ = d[0]; *out++ = d[1]; *out++ = d[2]; }
+}
+
+int64_t ymx_n_rows(void* h) { return static_cast<Mirror*>(h)->n_rows(); }
+int64_t ymx_n_slots(void* h) {
+  return (int64_t)static_cast<Mirror*>(h)->client_of_slot.size();
+}
+int64_t ymx_n_segs(void* h) { return static_cast<Mirror*>(h)->n_segs(); }
+uint64_t ymx_gen(void* h) { return static_cast<Mirror*>(h)->gen; }
+
+// bulk row columns [start:] — 19 parallel int64 arrays
+void ymx_rows(void* h, int64_t start,
+              int64_t* slot, int64_t* clock, int64_t* len,
+              int64_t* oslot, int64_t* oclock, int64_t* rslot,
+              int64_t* rclock, int64_t* is_gc, int64_t* countable,
+              int64_t* ref, int64_t* seg, int64_t* src_kind,
+              int64_t* src_buf, int64_t* src_ofs, int64_t* src_end,
+              int64_t* src_ofs2, int64_t* src_end2, int64_t* src_count,
+              int64_t* src_v2, int64_t* host_deleted, int64_t* lww_deleted) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t n = m->n_rows();
+  for (int64_t r = start; r < n; r++) {
+    int64_t i = r - start;
+    slot[i] = m->r_slot[r]; clock[i] = m->r_clock[r]; len[i] = m->r_len[r];
+    oslot[i] = m->r_oslot[r]; oclock[i] = m->r_oclock[r];
+    rslot[i] = m->r_rslot[r]; rclock[i] = m->r_rclock[r];
+    is_gc[i] = m->r_is_gc[r]; countable[i] = m->r_countable[r];
+    ref[i] = m->r_ref[r]; seg[i] = m->r_seg[r];
+    const ContentDesc& c = m->r_c[(size_t)r];
+    src_kind[i] = c.kind; src_buf[i] = c.buf;
+    src_ofs[i] = c.ofs; src_end[i] = c.end;
+    src_ofs2[i] = c.ofs2; src_end2[i] = c.end2;
+    src_count[i] = c.count; src_v2[i] = c.v2;
+    host_deleted[i] = m->r_host_deleted[r];
+    lww_deleted[i] = m->r_lww_deleted[r];
+  }
+}
+
+// device static columns for rows [start:] (engine _upload_statics shapes)
+void ymx_static_cols(void* h, int64_t start, uint32_t* client_key,
+                     int32_t* oslot, int32_t* oclock, int32_t* rslot,
+                     int32_t* rclock, int32_t* origin_row) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t n = m->n_rows();
+  for (int64_t r = start; r < n; r++) {
+    int64_t i = r - start;
+    client_key[i] = (uint32_t)m->client_of_slot[(size_t)m->r_slot[r]];
+    oslot[i] = (int32_t)m->r_oslot[r];
+    oclock[i] = (int32_t)m->r_oclock[r];
+    rslot[i] = (int32_t)m->r_rslot[r];
+    rclock[i] = (int32_t)m->r_rclock[r];
+    if (m->r_oslot[r] == kNull) {
+      origin_row[i] = (int32_t)kNull;
+    } else {
+      int64_t fi = m->frag_containing(m->r_oslot[r], m->r_oclock[r]);
+      origin_row[i] =
+          (int32_t)(fi == kNull ? kNull
+                                : m->frag_row[(size_t)m->r_oslot[r]][(size_t)fi]);
+    }
+  }
+}
+
+void ymx_clients(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (int64_t c : m->client_of_slot) *out++ = c;
+}
+
+void ymx_state(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (int64_t s : m->state) *out++ = s;
+}
+
+void ymx_segs(void* h, int64_t* name_ofs, int64_t* name_len,
+              int64_t* sub_ofs, int64_t* sub_len, int64_t* parent_row) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (int64_t s = 0; s < m->n_segs(); s++) {
+    int64_t ni = m->seg_name_id[s], si = m->seg_sub_id[s];
+    name_ofs[s] = ni == kNull ? kNull : m->intern_ofs[(size_t)ni];
+    name_len[s] = ni == kNull ? 0 : m->intern_len[(size_t)ni];
+    sub_ofs[s] = si == kNull ? kNull : m->intern_ofs[(size_t)si];
+    sub_len[s] = si == kNull ? 0 : m->intern_len[(size_t)si];
+    parent_row[s] = m->seg_parent[s];
+  }
+}
+
+uint64_t ymx_strings_len(void* h) {
+  return (uint64_t)static_cast<Mirror*>(h)->strings.size();
+}
+void ymx_strings(void* h, uint8_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  std::memcpy(out, m->strings.data(), m->strings.size());
+}
+
+int64_t ymx_chain_len(void* h, int64_t seg) {
+  Mirror* m = static_cast<Mirror*>(h);
+  auto it = m->map_chain.find(seg);
+  return it == m->map_chain.end() ? 0 : (int64_t)it->second.size();
+}
+void ymx_chain(void* h, int64_t seg, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  auto it = m->map_chain.find(seg);
+  if (it == m->map_chain.end()) return;
+  for (int64_t r : it->second) *out++ = r;
+}
+
+// raw DS ranges in slot first-note order: (slot, clock, len) triples
+int64_t ymx_ds_count(void* h) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t n = 0;
+  for (auto& [s, v] : m->ds) n += (int64_t)v.size();
+  return n;
+}
+void ymx_ds(void* h, int64_t* slot, int64_t* clock, int64_t* len) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (int64_t s : m->ds_slot_order)
+    for (auto& [c, l] : m->ds[s]) { *slot++ = s; *clock++ = c; *len++ = l; }
+}
+
+// fragment-index export: per-slot sizes, then one slot's (clock, row)
+// pairs — lets the facade mirror the index with memcpys instead of a
+// Python-side sort/rebuild
+void ymx_frag_counts(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  for (size_t s = 0; s < m->client_of_slot.size(); s++)
+    out[s] = (int64_t)m->frag_clock[s].size();
+}
+
+void ymx_frag(void* h, int64_t slot, int64_t* clocks, int64_t* rows) {
+  Mirror* m = static_cast<Mirror*>(h);
+  auto& fc = m->frag_clock[(size_t)slot];
+  auto& fr = m->frag_row[(size_t)slot];
+  std::memcpy(clocks, fc.data(), fc.size() * sizeof(int64_t));
+  std::memcpy(rows, fr.data(), fr.size() * sizeof(int64_t));
+}
+
+int64_t ymx_pending_depth(void* h) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t depth = (int64_t)m->pending_ds.size();
+  for (auto& [c, q] : m->pending) depth += (int64_t)q.size();
+  return depth;
+}
+int ymx_has_pending(void* h) {
+  Mirror* m = static_cast<Mirror*>(h);
+  return (m->pending.empty() && m->pending_ds.empty()) ? 0 : 1;
+}
+
+int64_t ymx_find_seg(void* h, const uint8_t* name, int64_t name_len,
+                     const uint8_t* sub, int64_t sub_len, int64_t parent_row) {
+  Mirror* m = static_cast<Mirror*>(h);
+  auto find_id = [&](const uint8_t* p, int64_t n) -> int64_t {
+    if (n < 0) return kNull;
+    std::string key(reinterpret_cast<const char*>(p), (size_t)n);
+    auto it = m->interned.find(key);
+    return it == m->interned.end() ? -2 : it->second;  // -2: never interned
+  };
+  int64_t ni = find_id(name, name_len);
+  int64_t si = find_id(sub, sub_len);
+  if (ni == -2 || si == -2) return kNull;
+  auto it = m->seg_lookup.find(std::make_tuple(ni, si, parent_row));
+  return it == m->seg_lookup.end() ? kNull : it->second;
+}
+
+int64_t ymx_segs_of_parent(void* h, int64_t row, int64_t* out, int64_t cap) {
+  Mirror* m = static_cast<Mirror*>(h);
+  auto it = m->segs_of_parent.find(row);
+  if (it == m->segs_of_parent.end()) return 0;
+  int64_t n = 0;
+  for (int64_t s : it->second) {
+    if (n < cap) out[n] = s;
+    n++;
+  }
+  return n;
+}
+
+// copy bytes out of a registered buffer (arena chunks included) so Python
+// can realize synthesized content
+int ymx_copy_bytes(void* h, int64_t buf, int64_t ofs, int64_t end,
+                   uint8_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  if (buf < 0 || (size_t)buf >= m->bufs.size()) return -1;
+  if (ofs < 0 || end < ofs || (uint64_t)end > m->buf_len(buf)) return -1;
+  std::memcpy(out, m->buf_ptr(buf) + ofs, (size_t)(end - ofs));
+  return 0;
+}
+
+int64_t ymx_compact(void* h, const int32_t* right_link,
+                    const uint8_t* deleted, const int32_t* heads,
+                    int64_t n_heads, int gc, int32_t* new_right,
+                    uint8_t* new_deleted, int32_t* new_heads,
+                    int64_t new_heads_cap) {
+  return static_cast<Mirror*>(h)->compact(right_link, deleted, heads,
+                                          n_heads, gc, new_right,
+                                          new_deleted, new_heads,
+                                          new_heads_cap);
+}
+
+}  // extern "C"

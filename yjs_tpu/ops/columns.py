@@ -441,6 +441,7 @@ class StepPlan:
     )
     levels: list[int] = field(default_factory=list)
     n_levels: int = 0
+    max_width: int = 0  # widest level (engine pack bucket sizing)
 
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
@@ -537,6 +538,10 @@ class StepPlan:
             tails[members[-1]] = (len(self.sched8) - 1, left, right, lev)
             n_levels = max(n_levels, lev)
         self.n_levels = n_levels
+        width = [0] * n_levels
+        for lev in self.levels:
+            width[lev - 1] += 1
+        self.max_width = max(width, default=0)
 
     def packed_levels(self):
         """The 8-field schedule grouped level-major ([L, W, 8] device pack)."""
@@ -1656,9 +1661,13 @@ class DocMirror:
         else:
             group_start = group_len = group_client = np.zeros(0, np.int64)
 
-        # spill pass: realized contents and partial non-string first structs
+        # spill pass: realized contents, partial non-string first structs,
+        # and V2-framed payloads that have no V1-compatible byte range
+        from ..native import SRC_V2LAZY
+
         spill_idx = np.flatnonzero(
             (cols["src_kind"] == SRC_SPILL)
+            | (cols["src_kind"] == SRC_V2LAZY)
             | ((cols["src_kind"] == SRC_FRAMED) & (cols["offset"] > 0))
         )
         spill = UpdateEncoderV1()
@@ -1859,3 +1868,7 @@ class DocMirror:
 
     def has_pending(self) -> bool:
         return bool(self.pending) or bool(self.pending_ds)
+
+    def pending_depth(self) -> int:
+        """Parked refs + delete ranges awaiting causal deps (metrics)."""
+        return sum(len(q) for q in self.pending.values()) + len(self.pending_ds)

@@ -31,7 +31,16 @@ from ..core import Doc
 from ..lib0.u16 import from_u16
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
+from .native_mirror import NativeMirror, native_plan_available
 from . import kernels
+
+
+def make_mirror(root_name: str):
+    """DocMirror served by the C++ plan core when available; the pure-
+    Python mirror otherwise (no toolchain / YTPU_NO_NATIVE_PLAN)."""
+    if native_plan_available():
+        return NativeMirror(root_name)
+    return DocMirror(root_name)
 
 
 def visible_text(mirror, rows, deleted) -> str:
@@ -156,7 +165,7 @@ class BatchEngine:
             from ..parallel.mesh import sharded_batch_step
 
             self._sharded_step = sharded_batch_step(mesh, doc_axis)
-        self.mirrors: list[DocMirror] = [DocMirror(root_name) for _ in range(n_docs)]
+        self.mirrors: list = [make_mirror(root_name) for _ in range(n_docs)]
         # CPU fallback docs (Provider gating): doc idx -> Doc
         self.fallback: dict[int, Doc] = {}
         # every demotion ever, with its reason — scope gaps are measurable,
@@ -328,7 +337,7 @@ class BatchEngine:
         for i, p in plans.items():
             m = self.mirrors[i]
             n = m.n_rows
-            start = 0 if p.splits else self._uploaded_rows[i]
+            start = 0 if len(p.splits) else self._uploaded_rows[i]
             if n <= start:
                 continue
             cols = m.static_columns(start)
@@ -468,10 +477,11 @@ class BatchEngine:
             n_del = _bucket(
                 max((len(p.delete_rows) for p in plans.values()), default=0), 1
             )
-            packed = {i: p.packed_levels() for i, p in plans.items()}
-            n_lv = _bucket(max((len(pk) for pk in packed.values()), default=0), 1)
+            n_lv = _bucket(
+                max((p.n_levels for p in plans.values()), default=0), 1
+            )
             w_lv = _bucket(
-                max((len(lv) for pk in packed.values() for lv in pk), default=0), 1
+                max((p.max_width for p in plans.values()), default=0), 1
             )
             max_rows = max((p.n_rows for p in plans.values()), default=0)
             max_segs = max(
@@ -487,14 +497,17 @@ class BatchEngine:
             lv_sched = np.full((b, n_lv, w_lv, 8), NULL, np.int32)
             dels = np.full((b, n_del), NULL, np.int32)
             for i, p in plans.items():
-                if p.splits:
+                if len(p.splits):
                     splits[i, : len(p.splits)] = p.splits
-                if p.sched:
+                if len(p.sched):
                     sched[i, : len(p.sched)] = p.sched
-                for lv, entries in enumerate(packed[i]):
-                    if entries:
-                        lv_sched[i, lv, : len(entries)] = entries
-                if p.delete_rows:
+                if hasattr(p, "pack_into"):
+                    p.pack_into(lv_sched[i])
+                else:
+                    for lv, entries in enumerate(p.packed_levels()):
+                        if entries:
+                            lv_sched[i, lv, : len(entries)] = entries
+                if len(p.delete_rows):
                     dels[i, : len(p.delete_rows)] = p.delete_rows
 
             # EVERY doc needs its true row count here — masked scatter lanes
@@ -587,7 +600,7 @@ class BatchEngine:
             "n_docs_flushed": sum(
                 1
                 for p in plans.values()
-                if p.sched8 or p.splits or p.delete_rows
+                if len(p.sched8) or len(p.splits) or len(p.delete_rows)
             ),
             "n_rows_max": max_rows,
             "n_sched_entries": n_sched_entries,
@@ -597,9 +610,7 @@ class BatchEngine:
             "schedule_occupancy": n_sched_entries / lv_slots if lv_slots else 0.0,
             "n_pending_docs": len(pending_docs),
             "pending_depth": sum(
-                sum(len(q) for q in self.mirrors[i].pending.values())
-                + len(self.mirrors[i].pending_ds)
-                for i in pending_docs
+                self.mirrors[i].pending_depth() for i in pending_docs
             ),
             "t_pack_s": t_pack - t_plan,
             "t_dispatch_s": t_dispatch - t_pack,

@@ -1,0 +1,458 @@
+"""NativeMirror: DocMirror's interface served by the C++ plan core.
+
+The flush hot path (ingest -> prepare_step -> static_columns) runs entirely
+inside yjs_tpu/native/plancore.cpp — the per-item Python interpreter cost
+that dominated the distinct-doc benchmark (r2 VERDICT: 11.6ms/doc of plan
+building) drops to one ctypes call per flush.  Everything *outside* the hot
+path — exports, wire encodes, event payloads — is served by lazily syncing
+the C++ columns into a shadow :class:`DocMirror` and delegating to its
+(pure-read) methods, so the two implementations cannot drift in behavior:
+the shadow IS the reference implementation operating on the same data.
+
+Scope fallbacks keep semantics identical to the Python mirror:
+- subdocuments (ContentDoc) raise :class:`UnsupportedUpdate`, demoting the
+  doc to the CPU core exactly like the Python path (engine policy seam);
+- payloads the native scanner will not carry (legacy ContentJSON inside a
+  V2 update) also raise UnsupportedUpdate — the engine's CPU fallback
+  serves them;
+- malformed updates raise the same decode errors as the Python path
+  (re-validated through decode_update_refs so the error type matches).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..lib0.decoding import Decoder
+from ..lib0 import decoding
+from ..lib0.u16 import utf8_decode_u16
+from ..native import (
+    SRC_ANYS,
+    SRC_DELETED,
+    SRC_FRAMED,
+    SRC_JSONS,
+    SRC_NONE,
+    SRC_UTF8,
+    SRC_V2LAZY,
+    has_plancore,
+    load,
+)
+from .columns import (
+    NULL,
+    DocMirror,
+    LazyContent,
+    LazyContentV2,
+    UnsupportedUpdate,
+    decode_update_refs,
+)
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+
+
+def native_plan_available() -> bool:
+    # env opt-out first: has_plancore() may trigger the g++ build
+    return not os.environ.get("YTPU_NO_NATIVE_PLAN") and has_plancore()
+
+
+def _p64(a: np.ndarray):
+    return a.ctypes.data_as(_i64p)
+
+
+def _p32(a: np.ndarray):
+    return a.ctypes.data_as(_i32p)
+
+
+class NativePlan:
+    """Array-backed step plan (the C++ twin of :class:`StepPlan`).
+
+    ``splits``/``sched``/``sched8``/``delete_rows`` are numpy arrays (use
+    ``len()``, not truthiness); ``applied_ds`` is a plain list of tuples
+    for the encode path.  ``pack_into`` fills an engine-allocated
+    ``[L, W, 8]`` int32 block level-major (vectorized, no per-entry
+    Python)."""
+
+    def __init__(self, lib, h, counts):
+        (self.n_rows, n_splits, n_sched, n_s8, self.n_levels,
+         self.max_width, n_del, n_ads) = (int(x) for x in counts[:8])
+        self.splits = np.empty((n_splits, 2), np.int64)
+        self.sched = np.empty((n_sched, 4), np.int64)
+        self.sched8 = np.empty((n_s8, 8), np.int64)
+        self.levels = np.empty(n_s8, np.int64)
+        self.delete_rows = np.empty(n_del, np.int64)
+        ads = np.empty((n_ads, 3), np.int64)
+        if n_splits:
+            lib.ymx_plan_splits(h, _p64(self.splits))
+        if n_sched:
+            lib.ymx_plan_sched(h, _p64(self.sched))
+        if n_s8:
+            lib.ymx_plan_sched8(h, _p64(self.sched8), _p64(self.levels))
+        if n_del:
+            lib.ymx_plan_deletes(h, _p64(self.delete_rows))
+        if n_ads:
+            lib.ymx_plan_applied_ds(h, _p64(ads))
+        self.applied_ds = [tuple(row) for row in ads.tolist()]
+
+    def pack_into(self, block: np.ndarray) -> None:
+        if not len(self.sched8):
+            return
+        lv = self.levels - 1
+        idx = np.argsort(lv, kind="stable")
+        sorted_lv = lv[idx]
+        starts = np.searchsorted(sorted_lv, np.arange(block.shape[0]))
+        pos = np.arange(len(idx)) - starts[sorted_lv]
+        block[sorted_lv, pos] = self.sched8[idx].astype(block.dtype)
+
+    def packed_levels(self):
+        out: list[list[tuple[int, ...]]] = [[] for _ in range(self.n_levels)]
+        for entry, lev in zip(self.sched8.tolist(), self.levels.tolist()):
+            out[lev - 1].append(tuple(entry))
+        return out
+
+
+class NativeMirror:
+    """Drop-in DocMirror replacement backed by the native plan core."""
+
+    def __init__(self, root_name: str = "text"):
+        lib = load()
+        if lib is None or not getattr(lib, "_has_plancore", False):
+            raise RuntimeError("native plan core unavailable")
+        self._lib = lib
+        self._h = lib.ymx_new()
+        self.root_name = root_name
+        self._incoming: list[tuple[bytes, bool]] = []
+        # buf id -> (bytes, pinned nparray view) keeping pointers stable
+        self._py_bufs: dict[int, tuple[bytes, np.ndarray]] = {}
+        self._realized: dict[int, object] = {}
+        self._py = DocMirror(root_name)
+        # spill/encode paths realize through the descriptor columns
+        self._py.realized_content = self.realized_content
+        self._synced_gen = -1
+        # extra per-row source columns the shadow DocMirror has no slot for
+        self._src_ofs2: list[int] = []
+        self._src_end2: list[int] = []
+        self._src_count: list[int] = []
+        self._src_v2: list[int] = []
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ymx_free(h)
+
+    # -- hot path -----------------------------------------------------------
+
+    def ingest(self, update: bytes, v2: bool = False) -> None:
+        self._incoming.append((update, v2))
+
+    def prepare_step(self) -> NativePlan:
+        lib, h = self._lib, self._h
+        staged = self._incoming
+        n_up = len(staged)
+        ids = np.empty(max(1, n_up), np.int64)
+        v2s = np.empty(max(1, n_up), np.int64)
+        for j, (u, v2) in enumerate(staged):
+            arr = np.frombuffer(u, np.uint8)
+            bid = lib.ymx_add_buf(
+                h, arr.ctypes.data_as(_u8p), ctypes.c_uint64(len(u))
+            )
+            self._py_bufs[int(bid)] = (u, arr)
+            ids[j] = bid
+            v2s[j] = 1 if v2 else 0
+        counts = np.zeros(12, np.int64)
+        rc = lib.ymx_prepare(h, _p64(ids), _p64(v2s), n_up, _p64(counts))
+        self._incoming = []
+        if rc == -9:
+            raise UnsupportedUpdate("subdocument (content ref 9)")
+        if rc != 0:
+            # truly malformed bytes must raise the same error the Python
+            # mirror would; anything the Python decoder accepts is a
+            # native-scope limitation -> demote like other scope gaps
+            try:
+                for u, v2 in staged:
+                    decode_update_refs(u, v2)
+            except Exception:
+                # scan-phase failure: nothing merged; unregister the staged
+                # buffers so a catch-and-retry loop cannot accumulate pins
+                if n_up:
+                    first = int(ids[0])
+                    lib.ymx_drop_bufs_from(h, first)
+                    for j in range(n_up):
+                        self._py_bufs.pop(int(ids[j]), None)
+                self._incoming = staged
+                raise
+            raise UnsupportedUpdate(f"native plan: unsupported payload (rc={rc})")
+        self._realized.clear()
+        return NativePlan(lib, h, counts)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self._lib.ymx_n_rows(self._h))
+
+    @property
+    def n_segs(self) -> int:
+        return int(self._lib.ymx_n_segs(self._h))
+
+    def has_pending(self) -> bool:
+        return bool(self._lib.ymx_has_pending(self._h))
+
+    def pending_depth(self) -> int:
+        return int(self._lib.ymx_pending_depth(self._h))
+
+    def state_vector(self) -> dict[int, int]:
+        lib, h = self._lib, self._h
+        ns = int(lib.ymx_n_slots(h))
+        if ns == 0:
+            return {}
+        clients = np.empty(ns, np.int64)
+        state = np.empty(ns, np.int64)
+        lib.ymx_clients(h, _p64(clients))
+        lib.ymx_state(h, _p64(state))
+        return {
+            int(c): int(s) for c, s in zip(clients, state) if s > 0
+        }
+
+    def static_columns(self, start: int = 0) -> dict[str, np.ndarray]:
+        lib, h = self._lib, self._h
+        n = self.n_rows - start
+        client_key = np.empty(n, np.uint32)
+        cols = {k: np.empty(n, np.int32) for k in
+                ("origin_slot", "origin_clock", "right_slot", "right_clock",
+                 "origin_row")}
+        lib.ymx_static_cols(
+            h, start, client_key.ctypes.data_as(_u32p),
+            _p32(cols["origin_slot"]), _p32(cols["origin_clock"]),
+            _p32(cols["right_slot"]), _p32(cols["right_clock"]),
+            _p32(cols["origin_row"]),
+        )
+        return {"client_key": client_key, **cols}
+
+    # -- compaction ---------------------------------------------------------
+
+    def rebuild_compacted(self, right_link, deleted, head_of_seg, gc: bool):
+        lib, h = self._lib, self._h
+        n = self.n_rows
+        nseg = self.n_segs
+        right = np.ascontiguousarray(np.asarray(right_link)[: max(1, n)],
+                                     np.int32)
+        dele = np.ascontiguousarray(
+            np.asarray(deleted)[: max(1, n)].astype(np.uint8)
+        )
+        heads = np.ascontiguousarray(np.asarray(head_of_seg), np.int32)
+        new_right = np.full(max(1, n), NULL, np.int32)
+        new_del = np.zeros(max(1, n), np.uint8)
+        new_heads = np.full(max(1, nseg), NULL, np.int32)
+        n_new = lib.ymx_compact(
+            h, _p32(right), dele.ctypes.data_as(_u8p), _p32(heads),
+            len(heads), int(bool(gc)), _p32(new_right),
+            new_del.ctypes.data_as(_u8p), _p32(new_heads), len(new_heads),
+        )
+        self._realized.clear()
+        return (
+            new_right[:n_new],
+            new_del[:n_new].astype(bool),
+            new_heads,
+        )
+
+    # -- content realization -------------------------------------------------
+
+    def realized_content(self, row: int):
+        c = self._realized.get(row)
+        if c is not None:
+            return c
+        self._sync()
+        py = self._py
+        kind = py.row_src_kind[row]
+        ref = py.row_content_ref[row]
+        if kind == SRC_NONE:
+            return None
+        buf = py._bufs[py.row_src_buf[row]] if py.row_src_buf[row] >= 0 else b""
+        ofs, end = py.row_src_ofs[row], py.row_src_end[row]
+        if kind == SRC_DELETED:
+            from ..core import ContentDeleted
+
+            c = ContentDeleted(py.row_len[row])
+        elif kind == SRC_UTF8:
+            from ..core import ContentString
+
+            c = ContentString(utf8_decode_u16(buf[ofs:end]))
+        elif kind == SRC_FRAMED:
+            c = LazyContent(buf, ofs, ref, end).realize()
+        elif kind in (SRC_ANYS, SRC_JSONS):
+            # synthesize the V1 framing (varuint count + elements) and use
+            # the reference read path so element semantics cannot drift
+            from ..lib0 import encoding as lib0enc
+
+            enc = lib0enc.Encoder()
+            lib0enc.write_var_uint(enc, self._src_count[row])
+            synth = enc.to_bytes() + buf[ofs:end]
+            c = LazyContent(synth, 0, ref, len(synth)).realize()
+        elif kind == SRC_V2LAZY:
+            c = LazyContentV2(
+                buf, ref, ofs, end,
+                self._src_ofs2[row], self._src_end2[row],
+                self._src_count[row],
+            ).realize()
+        else:  # SRC_SPILL never originates here
+            raise AssertionError(f"unexpected src kind {kind}")
+        self._realized[row] = c
+        return c
+
+    # -- shadow sync + delegation -------------------------------------------
+
+    def _sync(self) -> None:
+        lib, h = self._lib, self._h
+        gen = int(lib.ymx_gen(h))
+        if gen == self._synced_gen:
+            return
+        py = self._py
+        n = self.n_rows
+        cols = {k: np.empty(n, np.int64) for k in (
+            "slot", "clock", "len", "oslot", "oclock", "rslot", "rclock",
+            "is_gc", "countable", "ref", "seg", "src_kind", "src_buf",
+            "src_ofs", "src_end", "src_ofs2", "src_end2", "src_count",
+            "src_v2", "host_deleted", "lww_deleted",
+        )}
+        if n:
+            lib.ymx_rows(h, 0, *(_p64(cols[k]) for k in cols))
+        # numpy-backed shadow columns: the fetch is pure memcpy (no per-row
+        # Python boxing), and every DocMirror read path accepts sequence
+        # indexing — a 100k-row sync is a few MB of memcpy, not 2M tolist()
+        # boxings (r3 review finding)
+        py.row_slot = cols["slot"]
+        py.row_clock = cols["clock"]
+        py.row_len = cols["len"]
+        py.row_origin_slot = cols["oslot"]
+        py.row_origin_clock = cols["oclock"]
+        py.row_right_slot = cols["rslot"]
+        py.row_right_clock = cols["rclock"]
+        py.row_is_gc = cols["is_gc"]
+        py.row_countable = cols["countable"]
+        py.row_content = [None] * n
+        py.row_content_ref = cols["ref"]
+        py.row_seg = cols["seg"]
+        py.row_src_kind = cols["src_kind"]
+        py.row_src_buf = cols["src_buf"]
+        py.row_src_ofs = cols["src_ofs"]
+        py.row_src_end = cols["src_end"]
+        self._src_ofs2 = cols["src_ofs2"]
+        self._src_end2 = cols["src_end2"]
+        self._src_count = cols["src_count"]
+        self._src_v2 = cols["src_v2"]
+        py._host_deleted_rows = set(
+            np.flatnonzero(cols["host_deleted"]).tolist()
+        )
+        py._lww_deleted = set(np.flatnonzero(cols["lww_deleted"]).tolist())
+
+        ns = int(lib.ymx_n_slots(h))
+        clients = np.empty(max(1, ns), np.int64)
+        state = np.empty(max(1, ns), np.int64)
+        if ns:
+            lib.ymx_clients(h, _p64(clients))
+            lib.ymx_state(h, _p64(state))
+        py.client_of_slot = clients[:ns].tolist()
+        py.slot_of_client = {c: i for i, c in enumerate(py.client_of_slot)}
+        py.state = state[:ns].tolist()
+        # fragment index: straight memcpy of the C++ index (already sorted)
+        counts = np.zeros(max(1, ns), np.int64)
+        if ns:
+            lib.ymx_frag_counts(h, _p64(counts))
+        py.frag_clock = []
+        py.frag_row = []
+        for s in range(ns):
+            k = int(counts[s])
+            fc = np.empty(max(1, k), np.int64)
+            fr = np.empty(max(1, k), np.int64)
+            if k:
+                lib.ymx_frag(h, s, _p64(fc), _p64(fr))
+            py.frag_clock.append(fc[:k])
+            py.frag_row.append(fr[:k])
+
+        # segments + interned strings
+        nseg = self.n_segs
+        blob_len = int(lib.ymx_strings_len(h))
+        blob = np.empty(max(1, blob_len), np.uint8)
+        if blob_len:
+            lib.ymx_strings(h, blob.ctypes.data_as(_u8p))
+        py._strings = bytearray(blob[:blob_len].tobytes())
+        segc = {k: np.empty(max(1, nseg), np.int64) for k in
+                ("name_ofs", "name_len", "sub_ofs", "sub_len", "parent")}
+        if nseg:
+            lib.ymx_segs(h, *(_p64(segc[k]) for k in segc))
+        py.seg_name_ofs = segc["name_ofs"][:nseg].tolist()
+        py.seg_name_len = segc["name_len"][:nseg].tolist()
+        py.seg_sub_ofs = segc["sub_ofs"][:nseg].tolist()
+        py.seg_sub_len = segc["sub_len"][:nseg].tolist()
+        sb = bytes(py._strings)
+        seg_info = []
+        for i in range(nseg):
+            no, nl = py.seg_name_ofs[i], py.seg_name_len[i]
+            so, sl = py.seg_sub_ofs[i], py.seg_sub_len[i]
+            name = utf8_decode_u16(sb[no : no + nl]) if no >= 0 else None
+            sub = utf8_decode_u16(sb[so : so + sl]) if so >= 0 else None
+            seg_info.append((name, sub, int(segc["parent"][i])))
+        py.seg_info = seg_info
+        py.segments = {key: i for i, key in enumerate(seg_info)}
+        py._segs_of_parent = {}
+        for i, (_n, _s, p) in enumerate(seg_info):
+            if p != NULL:
+                py._segs_of_parent.setdefault(p, []).append(i)
+        py.map_chain = {}
+        for i, (_n, sub, _p) in enumerate(seg_info):
+            if sub is None:
+                continue
+            cl = int(lib.ymx_chain_len(h, i))
+            if cl:
+                chain = np.empty(cl, np.int64)
+                lib.ymx_chain(h, i, _p64(chain))
+                py.map_chain[i] = chain.tolist()
+
+        # delete-set ranges in slot first-note order
+        nds = int(lib.ymx_ds_count(h))
+        ds_slot = np.empty(max(1, nds), np.int64)
+        ds_clock = np.empty(max(1, nds), np.int64)
+        ds_len = np.empty(max(1, nds), np.int64)
+        if nds:
+            lib.ymx_ds(h, _p64(ds_slot), _p64(ds_clock), _p64(ds_len))
+        py.ds = {}
+        for s, c, ln in zip(
+            ds_slot[:nds].tolist(), ds_clock[:nds].tolist(),
+            ds_len[:nds].tolist()
+        ):
+            py.ds.setdefault(s, []).append((c, ln))
+
+        # buffer table: Python-origin bytes + arena chunks fetched once
+        nb = int(lib.ymx_n_bufs(h))
+        bufs: list[bytes] = []
+        for i in range(nb):
+            known = self._py_bufs.get(i)
+            if known is not None:
+                bufs.append(known[0])
+            else:
+                ln = int(lib.ymx_buf_len(h, i))
+                chunk = np.empty(max(1, ln), np.uint8)
+                if ln:
+                    lib.ymx_copy_bytes(
+                        h, i, 0, ln, chunk.ctypes.data_as(_u8p)
+                    )
+                b = chunk[:ln].tobytes()
+                self._py_bufs[i] = (b, chunk)
+                bufs.append(b)
+        py._bufs = bufs
+
+        py._gen = gen
+        py._np_gen = -1
+        py._ds_gen = gen
+        py._ds_np_gen = -1
+        self._synced_gen = gen
+
+    def __getattr__(self, name):
+        if name.startswith("__") or "_py" not in self.__dict__:
+            raise AttributeError(name)
+        self._sync()
+        return getattr(self.__dict__["_py"], name)
