@@ -1,0 +1,39 @@
+"""Pre-generate the distinct-doc benchmark traces (bench.py variant 2).
+
+1024 distinct two-client editing traces (gen_trace seeds 1000..2023),
+stored as one file: varuint-free simple framing [u32 len][bytes]*.  The
+bench loads these instead of synthesizing traces at run time (workload
+generation is explicitly untimed, but 1024 CPU-core editing sessions take
+~10 minutes — far beyond the bench budget)."""
+
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.argv = [""]
+
+from bench import gen_trace  # noqa: E402
+
+N = int(os.environ.get("N_TRACES", "1024"))
+OPS = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
+out_path = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", f"distinct_traces_{OPS}.bin",
+)
+
+import io
+import zlib
+
+buf = io.BytesIO()
+buf.write(struct.pack("<II", N, OPS))
+for i in range(N):
+    u, _ = gen_trace(OPS, seed=1000 + i)
+    buf.write(struct.pack("<I", len(u)) + u)
+    if (i + 1) % 64 == 0:
+        print(f"{i + 1}/{N}", flush=True)
+with open(out_path + ".z.tmp", "wb") as f:
+    f.write(zlib.compress(buf.getvalue(), 9))
+os.replace(out_path + ".z.tmp", out_path + ".z")
+print("wrote", out_path + ".z")

@@ -19,7 +19,7 @@ COLS = (
     "row_slot", "row_clock", "row_len", "row_origin_slot",
     "row_origin_clock", "row_right_slot", "row_right_clock", "row_is_gc",
     "row_countable", "row_content_ref", "row_seg", "client_of_slot",
-    "state", "seg_info",
+    "state", "seg_info", "list_next", "head_of_seg",
 )
 
 
@@ -33,6 +33,11 @@ def assert_step_equal(pm, nm, pp, np_, ctx=""):
     assert pp.levels == np_.levels.tolist(), ctx
     assert sorted(pp.delete_rows) == sorted(np_.delete_rows.tolist()), ctx
     assert sorted(pp.applied_ds) == sorted(np_.applied_ds), ctx
+    # bulk-apply form: final link/head values must agree exactly
+    assert pp.link_rows == np_.link_rows.tolist(), ctx
+    assert pp.link_vals == np_.link_vals.tolist(), ctx
+    assert pp.head_segs == np_.head_segs.tolist(), ctx
+    assert pp.head_vals == np_.head_vals.tolist(), ctx
 
 
 def assert_state_equal(pm, nm, ctx="", encode=True):
@@ -71,8 +76,8 @@ def run_differential(updates, v2=False, flush_every=1):
         pm.ingest(u, v2)
         nm.ingest(u, v2)
         if (j + 1) % flush_every == 0 or j == len(updates) - 1:
-            pp = pm.prepare_step()
-            np_ = nm.prepare_step()
+            pp = pm.prepare_step(want_levels=True)
+            np_ = nm.prepare_step(want_levels=True)
             assert_step_equal(pm, nm, pp, np_, ctx=f"flush after update {j}")
     assert_state_equal(pm, nm, ctx="final")
     return pm, nm
@@ -235,3 +240,107 @@ def test_compaction_parity(rng):
             os.environ.pop("YTPU_NO_NATIVE_PLAN", None)
     assert texts["native"] == texts["python"]
     assert texts["native"][0] == a.get_text("text").to_string()
+
+
+def test_apply_vs_levels_vs_seq_device_state(rng):
+    """The three kernel paths (bulk apply / level-parallel YATA / per-item
+    YATA scan) must produce identical device link state and exports."""
+    import os
+
+    from yjs_tpu.ops import BatchEngine
+    import numpy as np
+
+    updates, a, _ = two_client_session(rng, 50, rich=True)
+    states = {}
+    for mode in ("apply", "levels", "seq"):
+        os.environ["YTPU_KERNEL"] = mode
+        try:
+            eng = BatchEngine(2)
+            for j, u in enumerate(updates):
+                eng.queue_update(0, u)
+                eng.queue_update(1, u)
+                if j % 7 == 6:
+                    eng.flush()
+            eng.flush()
+            n = eng.mirrors[0].n_rows
+            states[mode] = (
+                np.asarray(eng._right)[:, :n].tolist(),
+                np.asarray(eng._deleted)[:, :n].tolist(),
+                np.asarray(eng._starts).tolist(),
+                eng.text(0),
+                eng.map_json(0, "meta"),
+                eng.to_json(0, "list"),
+            )
+        finally:
+            os.environ.pop("YTPU_KERNEL", None)
+    assert states["apply"] == states["levels"]
+    assert states["apply"] == states["seq"]
+    assert states["apply"][3] == a.get_text("text").to_string()
+
+
+def test_host_links_match_device(rng):
+    """The planner's host list state IS the device state after a flush."""
+    import numpy as np
+
+    from yjs_tpu.ops import BatchEngine
+
+    updates, _, _ = two_client_session(rng, 40)
+    eng = BatchEngine(1)
+    for j, u in enumerate(updates):
+        eng.queue_update(0, u)
+        if j % 5 == 4:
+            eng.flush()
+    eng.flush()
+    m = eng.mirrors[0]
+    n = m.n_rows
+    dev_right = np.asarray(eng._right)[0, :n]
+    host_next = np.asarray(m.list_next if hasattr(m, "list_next")
+                           else m._py.list_next)
+    # device rows never touched by any list stay NULL on both sides
+    assert (dev_right == host_next[:n]).all()
+    dev_starts = np.asarray(eng._starts)[0, : m.n_segs]
+    host_heads = np.asarray(m.head_of_seg if hasattr(m, "head_of_seg")
+                            else m._py.head_of_seg)
+    assert (dev_starts == host_heads).all()
+
+
+def test_deleted_run_split_stays_deleted():
+    """Splitting an already-deleted run in a LATER flush must ship the new
+    fragment's deleted bit on the bulk-apply path (r3 review finding: the
+    levels/seq kernels copy it in their on-device split surgery, the apply
+    path has none — without the host-emitted delete lane the fragment's
+    text resurrected)."""
+    import os
+
+    from yjs_tpu.ops import BatchEngine
+
+    a = Y.Doc(gc=False)
+    a.client_id = 1
+    a.get_text("text").insert(0, "hello")
+    u1 = Y.encode_state_as_update(a)
+    sv1 = Y.encode_state_vector(a)
+    # B diverges BEFORE the delete: its insert's origin is mid-run
+    b = Y.Doc(gc=False)
+    b.client_id = 2
+    Y.apply_update(b, u1)
+    a.get_text("text").delete(0, 5)
+    u2 = Y.encode_state_as_update(a, sv1)
+    b.get_text("text").insert(1, "X")
+    u3 = Y.encode_state_as_update(b, sv1)
+    Y.apply_update(a, u3)
+    expect = a.get_text("text").to_string()
+    assert expect == "X"
+    for mode in ("apply", "levels", "seq"):
+        os.environ["YTPU_KERNEL"] = mode
+        try:
+            eng = BatchEngine(1)
+            for u in (u1,):
+                eng.queue_update(0, u)
+            eng.flush()
+            eng.queue_update(0, u2)
+            eng.flush()
+            eng.queue_update(0, u3)
+            eng.flush()
+            assert eng.text(0) == expect, f"{mode}: {eng.text(0)!r}"
+        finally:
+            os.environ.pop("YTPU_KERNEL", None)

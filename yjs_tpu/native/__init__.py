@@ -116,13 +116,17 @@ def load():
         lib.ymx_buf_len.restype = i64
         lib.ymx_buf_len.argtypes = [vp, i64]
         lib.ymx_prepare.restype = ctypes.c_int
-        lib.ymx_prepare.argtypes = [vp, i64p, i64p, i64, i64p]
+        lib.ymx_prepare.argtypes = [vp, i64p, i64p, i64, ctypes.c_int, i64p]
         for name, args in [
             ("ymx_plan_splits", [vp, i64p]),
             ("ymx_plan_sched", [vp, i64p]),
             ("ymx_plan_sched8", [vp, i64p, i64p]),
             ("ymx_plan_deletes", [vp, i64p]),
             ("ymx_plan_applied_ds", [vp, i64p]),
+            ("ymx_plan_links", [vp, i64p, i64p]),
+            ("ymx_links", [vp, i64p]),
+            ("ymx_heads", [vp, i64p]),
+            ("ymx_plan_heads", [vp, i64p, i64p]),
             ("ymx_clients", [vp, i64p]),
             ("ymx_state", [vp, i64p]),
             ("ymx_segs", [vp, i64p, i64p, i64p, i64p, i64p]),
@@ -156,6 +160,11 @@ def load():
         lib.ymx_static_cols.argtypes = [vp, i64, u32p] + [i32p] * 5
         lib.ymx_copy_bytes.restype = ctypes.c_int
         lib.ymx_copy_bytes.argtypes = [vp, i64, i64, i64, u8p]
+        lib.ymx_encode_bound.restype = i64
+        lib.ymx_encode_bound.argtypes = [vp]
+        lib.ymx_encode_diff.restype = i64
+        lib.ymx_encode_diff.argtypes = [vp, i64p, i64p, i64, i64p, i64,
+                                        ctypes.c_int, u8p, u64]
         lib.ymx_compact.restype = i64
         lib.ymx_compact.argtypes = [vp, i32p, u8p, i32p, i64, ctypes.c_int,
                                     i32p, u8p, i32p, i64]

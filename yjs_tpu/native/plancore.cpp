@@ -91,6 +91,10 @@ struct Plan {
   std::vector<int64_t> levels;
   int64_t n_levels = 0;
   int64_t max_width = 0;
+  // bulk-apply form: FINAL link/head values of everything this step
+  // changed (host-resolved YATA; see Mirror::list_insert)
+  std::set<int64_t> dirty_links, dirty_heads;
+  std::vector<int64_t> link_rows, link_vals, head_segs, head_vals;
 
   void clear() {
     n_rows = 0;
@@ -102,6 +106,12 @@ struct Plan {
     levels.clear();
     n_levels = 0;
     max_width = 0;
+    dirty_links.clear();
+    dirty_heads.clear();
+    link_rows.clear();
+    link_vals.clear();
+    head_segs.clear();
+    head_vals.clear();
   }
 };
 
@@ -127,6 +137,11 @@ struct Mirror {
   std::unordered_map<int64_t, std::vector<int64_t>> segs_of_parent;
   std::unordered_map<int64_t, std::vector<int64_t>> rows_of_seg;  // nested only
   std::unordered_map<int64_t, std::vector<int64_t>> map_chain;
+  // host linked lists: the mirror of the device right_link/starts state
+  // (the planner resolves YATA placement against these, so each flush
+  // ships final link values)
+  std::vector<int64_t> list_next;
+  std::vector<int64_t> head_of_seg;
 
   // interned strings (UTF-8 blob + ranges); key = raw bytes
   std::vector<uint8_t> strings;
@@ -192,6 +207,7 @@ struct Mirror {
     seg_name_id.push_back(name_id);
     seg_sub_id.push_back(sub_id);
     seg_parent.push_back(parent_row);
+    head_of_seg.push_back(kNull);
     if (parent_row != kNull) segs_of_parent[parent_row].push_back(s);
     return s;
   }
@@ -339,6 +355,7 @@ struct Mirror {
     r_c.push_back(c);
     r_ref.push_back(ref);
     r_seg.push_back(is_gc ? kNull : seg_);
+    list_next.push_back(kNull);
     r_host_deleted.push_back(0);
     r_lww_deleted.push_back(0);
     if (!is_gc && seg_ != kNull && seg_parent[seg_] != kNull)
@@ -388,7 +405,16 @@ struct Mirror {
         false, right, r_ref[row], sg);
     r_len[row] = offset;
     plan.splits.push_back({{row, new_row}});
-    if (r_host_deleted[row]) r_host_deleted[new_row] = 1;
+    list_next[new_row] = list_next[row];
+    list_next[row] = new_row;
+    plan.dirty_links.insert(row);
+    plan.dirty_links.insert(new_row);
+    if (r_host_deleted[row]) {
+      r_host_deleted[new_row] = 1;
+      // ship the fragment's deleted bit: the bulk-apply path has no
+      // on-device split surgery to copy it from the original
+      plan.delete_rows.push_back(new_row);
+    }
     if (sg != kNull && seg_is_map(sg)) {
       auto& chain = map_chain[sg];
       auto it = std::find(chain.begin(), chain.end(), row);
@@ -421,25 +447,21 @@ struct Mirror {
     return client_of_slot[r_slot[row]];
   }
 
-  void chain_insert(int64_t sg, int64_t row, int64_t left_row,
-                    int64_t right_row) {
-    auto& chain = map_chain[sg];
-    int64_t li = -1;
-    if (left_row != kNull) {
-      auto it = std::find(chain.begin(), chain.end(), left_row);
-      li = (int64_t)(it - chain.begin());
-    }
+  // resolve the row's YATA placement against the host list and splice —
+  // the host twin of the device conflict scan (reference Item.js:403-517,
+  // the same itemsBeforeOrigin/conflictingItems walk).  Returns the
+  // resolved left row (kNull = new head).
+  int64_t list_insert(int64_t sg, int64_t row, int64_t left_row,
+                      int64_t right_row, Plan* p) {
+    int64_t left = left_row;
+    int64_t o = left_row != kNull ? list_next[left_row] : head_of_seg[sg];
     std::set<int64_t> items_before, conflicting;
-    int64_t left_i = li;
-    int64_t i = li + 1;
-    while (i < (int64_t)chain.size()) {
-      int64_t o = chain[(size_t)i];
-      if (o == right_row) break;
+    while (o != kNull && o != right_row) {
       items_before.insert(o);
       conflicting.insert(o);
       if (row_origin_eq(row, o)) {
         if (row_client(o) < row_client(row)) {
-          left_i = i;
+          left = o;
           conflicting.clear();
         } else if (row_right_eq(row, o)) {
           break;
@@ -448,16 +470,27 @@ struct Mirror {
         int64_t oor = origin_row_of(o);
         if (oor != kNull && items_before.count(oor)) {
           if (!conflicting.count(oor)) {
-            left_i = i;
+            left = o;
             conflicting.clear();
           }
         } else {
           break;
         }
       }
-      i++;
+      o = list_next[o];
     }
-    chain.insert(chain.begin() + (size_t)(left_i + 1), row);
+    if (left != kNull) {
+      list_next[row] = list_next[left];
+      list_next[left] = row;
+      p->dirty_links.insert(left);
+      p->dirty_links.insert(row);
+    } else {
+      list_next[row] = head_of_seg[sg];
+      head_of_seg[sg] = row;
+      p->dirty_links.insert(row);
+      p->dirty_heads.insert(sg);
+    }
+    return left;
   }
 
   // ---- deletes (DocMirror._delete_row / _lww_pass) ----------------------
@@ -803,7 +836,7 @@ struct Mirror {
   // ---- the flush pipeline (DocMirror.prepare_step twin) -----------------
 
   int prepare(const int64_t* buf_ids, const int64_t* v2_flags,
-              int64_t n_updates) {
+              int64_t n_updates, bool want_levels) {
     const bool timing = std::getenv("YMX_TIMING") != nullptr;
     auto t0 = std::chrono::steady_clock::now();
     auto lap = [&](const char* what) {
@@ -1080,8 +1113,15 @@ struct Mirror {
       int64_t row = add_row(slot_, ref.clock, ref.length, ref.oc, ref.ok,
                             ref.rc, ref.rk, false, ref.c, ref.ref, sg);
       plan.sched.push_back({{row, left_row, right_row, sg}});
+      int64_t actual_left = list_insert(sg, row, left_row, right_row, &plan);
       if (seg_is_map(sg)) {
-        chain_insert(sg, row, left_row, right_row);
+        auto& chain = map_chain[sg];
+        if (actual_left == kNull) {
+          chain.insert(chain.begin(), row);
+        } else {
+          auto it = std::find(chain.begin(), chain.end(), actual_left);
+          chain.insert(it + 1, row);
+        }
         if (touched_set.insert(sg).second) touched_map_segs.push_back(sg);
       }
       int64_t pr = seg_parent[sg];
@@ -1114,8 +1154,19 @@ struct Mirror {
     lww_pass(touched_map_segs);
     lap("lww");
     plan.n_rows = n_rows();
-    assign_levels();
+    // the level-parallel schedule serves only the YATA device kernels
+    // (YTPU_KERNEL=levels/seq and the sharded step); the default bulk
+    // path ships final links and skips the level assignment entirely
+    if (want_levels) assign_levels();
     lap("levels");
+    for (int64_t r : plan.dirty_links) {
+      plan.link_rows.push_back(r);
+      plan.link_vals.push_back(list_next[(size_t)r]);
+    }
+    for (int64_t s : plan.dirty_heads) {
+      plan.head_segs.push_back(s);
+      plan.head_vals.push_back(head_of_seg[(size_t)s]);
+    }
     gen++;
     return 0;
   }
@@ -1468,14 +1519,18 @@ struct Mirror {
     }
     for (int64_t sg = 0; sg < std::min(new_heads_cap, n_segs()); sg++)
       new_heads[sg] = (int32_t)kNull;
+    list_next.assign((size_t)n_new, kNull);
+    head_of_seg.assign((size_t)n_segs(), kNull);
     for (int64_t sg = 0; sg < n_segs(); sg++) {
       int64_t prev = kNull;
       for (int64_t old : order_of_seg[(size_t)sg]) {
         int64_t nr = new_of_old[(size_t)old];
         if (prev == kNull) {
           if (sg < new_heads_cap) new_heads[sg] = (int32_t)nr;
+          head_of_seg[(size_t)sg] = nr;
         } else {
           new_right[prev] = (int32_t)nr;
+          list_next[(size_t)prev] = nr;
         }
         prev = nr;
       }
@@ -1483,6 +1538,166 @@ struct Mirror {
     return n_new;
   }
 };
+
+}  // namespace
+
+// the V1 wire writer (transcode.cpp, same shared object)
+extern "C" int64_t ytpu_encode_v1(
+    const uint8_t** bufs, const uint64_t* buf_lens, uint64_t n_bufs,
+    const int64_t* group_client, const int64_t* group_start,
+    const int64_t* group_len, uint64_t n_groups,
+    const int64_t* clock, const int64_t* length, const int64_t* offset,
+    const int64_t* origin_client, const int64_t* origin_clock,
+    const int64_t* right_client, const int64_t* right_clock,
+    const int64_t* content_ref,
+    const int64_t* name_ofs, const int64_t* name_len,
+    const int64_t* sub_ofs, const int64_t* sub_len,
+    const int64_t* parent_client, const int64_t* parent_clock,
+    const int64_t* src_kind, const int64_t* src_buf,
+    const int64_t* src_ofs, const int64_t* src_end,
+    const uint8_t* strings, uint64_t strings_len,
+    const int64_t* ds_group_client, const int64_t* ds_group_start,
+    const int64_t* ds_group_len, uint64_t n_ds_groups,
+    const int64_t* ds_clock, const int64_t* ds_len,
+    uint8_t* out, uint64_t out_cap);
+
+namespace {
+
+// full-native sync encode: rows beyond a remote state vector, written
+// straight from the mirror state (reference encodeStateAsUpdate,
+// encoding.js:490-526 + writeClientsStructs :94-116).  Returns bytes
+// written, -7 when a selected row needs the Python spill path (V2-framed
+// embed/format/type payloads), <0 on writer errors.
+int64_t mirror_encode_diff(Mirror* m, const int64_t* sv_clients,
+                           const int64_t* sv_clocks, int64_t n_sv,
+                           const int64_t* ds_ranges, int64_t n_ds_override,
+                           int ds_override, uint8_t* out, uint64_t cap) {
+  size_t n_slots = m->client_of_slot.size();
+  std::vector<int64_t> remote(n_slots, 0);
+  for (int64_t i = 0; i < n_sv; i++) {
+    auto it = m->slot_of_client.find(sv_clients[i]);
+    if (it != m->slot_of_client.end())
+      remote[(size_t)it->second] = sv_clocks[i];
+  }
+  // slots in descending client order ("heavily improves the conflict
+  // algorithm", encoding.js:112)
+  std::vector<size_t> slot_order(n_slots);
+  for (size_t s = 0; s < n_slots; s++) slot_order[s] = s;
+  std::sort(slot_order.begin(), slot_order.end(), [&](size_t a, size_t b) {
+    return m->client_of_slot[a] > m->client_of_slot[b];
+  });
+  // selected rows, flat in group order
+  std::vector<int64_t> g_client, g_start, g_len;
+  std::vector<int64_t> c_clock, c_len, c_ofs, c_oc, c_ok, c_rc, c_rk, c_ref;
+  std::vector<int64_t> c_no, c_nl, c_so, c_sl, c_pc, c_pk;
+  std::vector<int64_t> c_sk, c_sb, c_sofs, c_send;
+  for (size_t si : slot_order) {
+    int64_t rem = remote[si];
+    size_t start = c_clock.size();
+    for (int64_t r : m->frag_row[si]) {
+      int64_t end = m->r_clock[r] + m->r_len[r];
+      if (end <= rem) continue;
+      const ContentDesc& c = m->r_c[(size_t)r];
+      if (c.kind == kKindV2Lazy || c.kind == kKindSpill) return -7;
+      int64_t off = std::max<int64_t>(0, rem - m->r_clock[r]);
+      c_clock.push_back(m->r_clock[r]);
+      c_len.push_back(m->r_len[r]);
+      c_ofs.push_back(off);
+      c_oc.push_back(m->r_oslot[r] == kNull
+                         ? kNull
+                         : m->client_of_slot[(size_t)m->r_oslot[r]]);
+      c_ok.push_back(m->r_oclock[r]);
+      c_rc.push_back(m->r_rslot[r] == kNull
+                         ? kNull
+                         : m->client_of_slot[(size_t)m->r_rslot[r]]);
+      c_rk.push_back(m->r_rclock[r]);
+      c_ref.push_back(m->r_ref[r]);
+      int64_t sg = m->r_seg[r];
+      int64_t ni = sg == kNull ? kNull : m->seg_name_id[sg];
+      int64_t sui = sg == kNull ? kNull : m->seg_sub_id[sg];
+      int64_t pr = sg == kNull ? kNull : m->seg_parent[sg];
+      c_no.push_back(ni == kNull ? kNull : m->intern_ofs[(size_t)ni]);
+      c_nl.push_back(ni == kNull ? 0 : m->intern_len[(size_t)ni]);
+      c_so.push_back(sui == kNull ? kNull : m->intern_ofs[(size_t)sui]);
+      c_sl.push_back(sui == kNull ? 0 : m->intern_len[(size_t)sui]);
+      c_pc.push_back(
+          pr == kNull ? kNull
+                      : m->client_of_slot[(size_t)m->r_slot[(size_t)pr]]);
+      c_pk.push_back(pr == kNull ? 0 : m->r_clock[(size_t)pr]);
+      c_sk.push_back(m->r_is_gc[r] ? kSrcNone : c.kind);
+      c_sb.push_back(c.buf);
+      c_sofs.push_back(c.ofs);
+      c_send.push_back(c.end);
+    }
+    if (c_clock.size() > start) {
+      g_client.push_back(m->client_of_slot[si]);
+      g_start.push_back((int64_t)start);
+      g_len.push_back((int64_t)(c_clock.size() - start));
+    }
+  }
+  // DS section
+  std::vector<int64_t> dg_client, dg_start, dg_len, d_clock, d_len;
+  auto push_union = [&](int64_t client,
+                        std::vector<std::array<int64_t, 2>>& ranges) {
+    std::sort(ranges.begin(), ranges.end());
+    size_t start = d_clock.size();
+    for (auto& [ck, ln] : ranges) {
+      if (!d_clock.empty() && d_clock.size() > start &&
+          ck <= d_clock.back() + d_len.back()) {
+        d_len.back() = std::max(d_len.back(), ck + ln - d_clock.back());
+      } else {
+        d_clock.push_back(ck);
+        d_len.push_back(ln);
+      }
+    }
+    if (d_clock.size() > start) {
+      dg_client.push_back(client);
+      dg_start.push_back((int64_t)start);
+      dg_len.push_back((int64_t)(d_clock.size() - start));
+    }
+  };
+  if (ds_override) {
+    // override ranges grouped by client in first-appearance order
+    std::vector<int64_t> order;
+    std::unordered_map<int64_t, std::vector<std::array<int64_t, 2>>> by;
+    for (int64_t i = 0; i < n_ds_override; i++) {
+      int64_t cl = ds_ranges[i * 3];
+      if (!by.count(cl)) order.push_back(cl);
+      by[cl].push_back({{ds_ranges[i * 3 + 1], ds_ranges[i * 3 + 2]}});
+    }
+    for (int64_t cl : order) push_union(cl, by[cl]);
+  } else {
+    for (int64_t slot : m->ds_slot_order) {
+      auto ranges = m->ds[slot];  // copy: union sorts
+      push_union(m->client_of_slot[(size_t)slot], ranges);
+    }
+  }
+  std::vector<const uint8_t*> bptrs;
+  std::vector<uint64_t> blens;
+  for (auto& [p, ln] : m->bufs) {
+    bptrs.push_back(p);
+    blens.push_back(ln);
+  }
+  static const uint8_t kNoBuf = 0;
+  if (bptrs.empty()) {
+    bptrs.push_back(&kNoBuf);
+    blens.push_back(0);
+  }
+  static const int64_t kZero = 0;
+  auto dat = [](std::vector<int64_t>& v) {
+    return v.empty() ? &kZero : v.data();
+  };
+  return ytpu_encode_v1(
+      bptrs.data(), blens.data(), bptrs.size(),
+      dat(g_client), dat(g_start), dat(g_len), g_client.size(),
+      dat(c_clock), dat(c_len), dat(c_ofs),
+      dat(c_oc), dat(c_ok), dat(c_rc), dat(c_rk), dat(c_ref),
+      dat(c_no), dat(c_nl), dat(c_so), dat(c_sl), dat(c_pc), dat(c_pk),
+      dat(c_sk), dat(c_sb), dat(c_sofs), dat(c_send),
+      m->strings.empty() ? &kNoBuf : m->strings.data(), m->strings.size(),
+      dat(dg_client), dat(dg_start), dat(dg_len), dg_client.size(),
+      dat(d_clock), dat(d_len), out, cap);
+}
 
 }  // namespace
 
@@ -1523,9 +1738,9 @@ int64_t ymx_buf_len(void* h, int64_t idx) {
 // max_width, n_delete_rows, n_applied_ds, has_pending, pending_depth,
 // n_slots, n_segs.  Returns 0 or an error code (<0).
 int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
-                int64_t n_updates, int64_t* out_counts) {
+                int64_t n_updates, int want_levels, int64_t* out_counts) {
   Mirror* m = static_cast<Mirror*>(h);
-  int rc = m->prepare(buf_ids, v2_flags, n_updates);
+  int rc = m->prepare(buf_ids, v2_flags, n_updates, want_levels != 0);
   if (rc != 0) return rc;
   int64_t depth = (int64_t)m->pending_ds.size();
   for (auto& [c, q] : m->pending) depth += (int64_t)q.size();
@@ -1541,7 +1756,25 @@ int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
   out_counts[9] = depth;
   out_counts[10] = (int64_t)m->client_of_slot.size();
   out_counts[11] = m->n_segs();
+  out_counts[12] = (int64_t)m->plan.link_rows.size();
+  out_counts[13] = (int64_t)m->plan.head_segs.size();
   return 0;
+}
+
+void ymx_plan_links(void* h, int64_t* rows, int64_t* vals) {
+  Mirror* m = static_cast<Mirror*>(h);
+  std::memcpy(rows, m->plan.link_rows.data(),
+              m->plan.link_rows.size() * sizeof(int64_t));
+  std::memcpy(vals, m->plan.link_vals.data(),
+              m->plan.link_vals.size() * sizeof(int64_t));
+}
+
+void ymx_plan_heads(void* h, int64_t* segs, int64_t* vals) {
+  Mirror* m = static_cast<Mirror*>(h);
+  std::memcpy(segs, m->plan.head_segs.data(),
+              m->plan.head_segs.size() * sizeof(int64_t));
+  std::memcpy(vals, m->plan.head_vals.data(),
+              m->plan.head_vals.size() * sizeof(int64_t));
 }
 
 void ymx_plan_splits(void* h, int64_t* out) {
@@ -1687,6 +1920,19 @@ void ymx_ds(void* h, int64_t* slot, int64_t* clock, int64_t* len) {
     for (auto& [c, l] : m->ds[s]) { *slot++ = s; *clock++ = c; *len++ = l; }
 }
 
+// host list state (the device right_link/starts mirror)
+void ymx_links(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  std::memcpy(out, m->list_next.data(),
+              m->list_next.size() * sizeof(int64_t));
+}
+
+void ymx_heads(void* h, int64_t* out) {
+  Mirror* m = static_cast<Mirror*>(h);
+  std::memcpy(out, m->head_of_seg.data(),
+              m->head_of_seg.size() * sizeof(int64_t));
+}
+
 // fragment-index export: per-slot sizes, then one slot's (clock, row)
 // pairs — lets the facade mirror the index with memcpys instead of a
 // Python-side sort/rebuild
@@ -1752,6 +1998,30 @@ int ymx_copy_bytes(void* h, int64_t buf, int64_t ofs, int64_t end,
   if (ofs < 0 || end < ofs || (uint64_t)end > m->buf_len(buf)) return -1;
   std::memcpy(out, m->buf_ptr(buf) + ofs, (size_t)(end - ofs));
   return 0;
+}
+
+// upper bound on any encode of this mirror (all rows + framing slack)
+int64_t ymx_encode_bound(void* h) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t content = 0;
+  for (auto& c : m->r_c)
+    content += (c.end >= 0 && c.ofs >= 0) ? (c.end - c.ofs) : 16;
+  int64_t n_ds = 0;
+  for (auto& [s, v] : m->ds) n_ds += (int64_t)v.size();
+  return 256 + m->n_rows() * 80 + content + (int64_t)m->strings.size() * 2 +
+         24 * n_ds;
+}
+
+// encode the diff against a remote state vector, fully natively.
+// sv: n_sv (client, clock) pairs.  ds_override!=0 replaces the derived
+// DeleteSet with the given (client, clock, len) triples.  Returns bytes
+// written, -7 = needs the Python spill path, other <0 = writer error.
+int64_t ymx_encode_diff(void* h, const int64_t* sv_clients,
+                        const int64_t* sv_clocks, int64_t n_sv,
+                        const int64_t* ds_ranges, int64_t n_ds,
+                        int ds_override, uint8_t* out, uint64_t cap) {
+  return mirror_encode_diff(static_cast<Mirror*>(h), sv_clients, sv_clocks,
+                            n_sv, ds_ranges, n_ds, ds_override, out, cap);
 }
 
 int64_t ymx_compact(void* h, const int32_t* right_link,

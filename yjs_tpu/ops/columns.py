@@ -442,6 +442,15 @@ class StepPlan:
     levels: list[int] = field(default_factory=list)
     n_levels: int = 0
     max_width: int = 0  # widest level (engine pack bucket sizing)
+    # bulk-apply form (the default device path): FINAL right-link values of
+    # every row whose link changed this step, plus segment-head updates —
+    # the host planner resolves YATA placement against its own list state,
+    # so the device applies one conflict-free scatter (the sort/rank-style
+    # layout; the YATA scan kernels remain as the levels/seq paths)
+    link_rows: list[int] = field(default_factory=list)
+    link_vals: list[int] = field(default_factory=list)
+    head_segs: list[int] = field(default_factory=list)
+    head_vals: list[int] = field(default_factory=list)
 
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
@@ -593,6 +602,11 @@ class DocMirror:
         # per-map-segment host chain: rows in YATA order (tiny lists — one
         # entry per concurrent writer of one key)
         self.map_chain: dict[int, list[int]] = {}
+        # host linked lists: the mirror of the device right_link/starts
+        # state, maintained by the planner's own YATA resolution so each
+        # flush ships final link values (StepPlan.link_*)
+        self.list_next: list[int] = []  # per row; NULL = tail/unlinked
+        self.head_of_seg: list[int] = []  # per seg; NULL = empty
         # reverse indexes for the recursive type-delete rule
         self._segs_of_parent: dict[int, list[int]] = {}
         self._rows_of_seg: dict[int, list[int]] = {}
@@ -704,6 +718,7 @@ class DocMirror:
             s = len(self.seg_info)
             self.segments[key] = s
             self.seg_info.append(key)
+            self.head_of_seg.append(NULL)
             if parent_row != NULL:
                 self._segs_of_parent.setdefault(parent_row, []).append(s)
             if name is None:
@@ -756,6 +771,7 @@ class DocMirror:
         self.row_content.append(content)
         self.row_content_ref.append(content_ref)
         self.row_seg.append(NULL if is_gc else seg)
+        self.list_next.append(NULL)
         # membership index only for NESTED segments (the recursive
         # type-delete rule's sole consumer) — not for every root row
         if not is_gc and seg != NULL and self.seg_info[seg][2] != NULL:
@@ -855,8 +871,16 @@ class DocMirror:
         )
         self.row_len[row] = offset
         plan.splits.append((row, new_row))
+        # host list splice of the fragment (device split surgery twin)
+        self.list_next[new_row] = self.list_next[row]
+        self.list_next[row] = new_row
+        plan._dl.update((row, new_row))
         if row in self._host_deleted_rows:
             self._host_deleted_rows.add(new_row)
+            # the new fragment's device deleted bit must ship too: the
+            # bulk-apply path has no on-device split surgery to copy it
+            # (levels/seq copy dl[orig] in their split pre-pass)
+            plan.delete_rows.append(new_row)
         if seg != NULL and self.seg_is_map(seg):
             # fragments of a map-chain entry sit adjacent in its chain
             chain = self.map_chain[seg]
@@ -904,26 +928,26 @@ class DocMirror:
             sa == NULL or self.row_right_clock[a] == self.row_right_clock[b]
         )
 
-    def _chain_insert(self, seg: int, row: int, left_row: int, right_row: int):
-        """Insert a new map entry at its YATA position in the segment chain —
-        the host twin of the device conflict scan (reference Item.js:447-470)
-        over the tiny per-key chain, so LWW deletes and map exports need no
-        device readback."""
-        chain = self.map_chain.setdefault(seg, [])
-        li = chain.index(left_row) if left_row != NULL else -1
+    def _list_insert(
+        self, seg: int, row: int, left_row: int, right_row: int, plan: StepPlan
+    ) -> int:
+        """Resolve the row's YATA placement against the host list state and
+        splice it — the host twin of the device conflict scan (reference
+        Item.js:403-517, the same itemsBeforeOrigin/conflictingItems walk).
+        Each flush thereby ships FINAL link values (StepPlan.link_*) and the
+        default device step is one conflict-free scatter.  Returns the
+        resolved left row (NULL = new head)."""
+        nxt = self.list_next
+        left = left_row
+        o = nxt[left_row] if left_row != NULL else self.head_of_seg[seg]
         items_before: set[int] = set()
         conflicting: set[int] = set()
-        left_i = li
-        i = li + 1
-        while i < len(chain):
-            o = chain[i]
-            if o == right_row:
-                break
+        while o != NULL and o != right_row:
             items_before.add(o)
             conflicting.add(o)
             if self._row_origin_eq(row, o):
                 if self._row_client(o) < self._row_client(row):
-                    left_i = i
+                    left = o
                     conflicting.clear()
                 elif self._row_right_eq(row, o):
                     break
@@ -931,12 +955,21 @@ class DocMirror:
                 oor = self._origin_row(o)
                 if oor != NULL and oor in items_before:
                     if oor not in conflicting:
-                        left_i = i
+                        left = o
                         conflicting.clear()
                 else:
                     break
-            i += 1
-        chain.insert(left_i + 1, row)
+            o = nxt[o]
+        if left != NULL:
+            nxt[row] = nxt[left]
+            nxt[left] = row
+            plan._dl.update((left, row))
+        else:
+            nxt[row] = self.head_of_seg[seg]
+            self.head_of_seg[seg] = row
+            plan._dl.add(row)
+            plan._dh.add(seg)
+        return left
 
     def _row_client(self, row: int) -> int:
         return self.client_of_slot[self.row_slot[row]]
@@ -978,8 +1011,12 @@ class DocMirror:
 
     # -- the flush pipeline -------------------------------------------------
 
-    def prepare_step(self) -> StepPlan:
+    def prepare_step(self, want_levels: bool | None = None) -> StepPlan:
         """Consume queued updates and produce the device step plan.
+
+        ``want_levels=False`` skips the level-parallel schedule (sched8 /
+        levels), which only the YATA device kernels consume — the default
+        bulk-apply path ships the final link values instead.
 
         Raises :class:`UnsupportedUpdate` if an incoming ref is outside the
         device path's scope (nested types, subdocuments).  The mirror may
@@ -1076,6 +1113,8 @@ class DocMirror:
             need_start(client, clock + ln)
 
         plan = StepPlan(n_rows=0)
+        plan._dl = set()  # rows whose list_next changed this step
+        plan._dh = set()  # segs whose head changed this step
 
         # cuts inside scheduled refs: fragment the refs themselves
         by_client_sched: dict[int, list[int]] = {}
@@ -1183,8 +1222,13 @@ class DocMirror:
                 ref.content, ref.content_ref, seg=seg,
             )
             plan.sched.append((row, left_row, right_row, seg))
+            actual_left = self._list_insert(seg, row, left_row, right_row, plan)
             if self.seg_is_map(seg):
-                self._chain_insert(seg, row, left_row, right_row)
+                chain = self.map_chain.setdefault(seg, [])
+                if actual_left == NULL:
+                    chain.insert(0, row)
+                else:
+                    chain.insert(chain.index(actual_left) + 1, row)
                 touched_map_segs.add(seg)
             # an item integrated into a deleted parent is deleted with it
             # (reference Item.js:500-505)
@@ -1215,7 +1259,13 @@ class DocMirror:
 
         self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
-        plan.assign_levels(self._row_client)
+        if want_levels is None or want_levels:
+            plan.assign_levels(self._row_client)
+        # finalize the bulk-apply deltas: FINAL values after all splices
+        plan.link_rows = sorted(plan._dl)
+        plan.link_vals = [self.list_next[r] for r in plan.link_rows]
+        plan.head_segs = sorted(plan._dh)
+        plan.head_vals = [self.head_of_seg[s] for s in plan.head_segs]
         return plan
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
@@ -1351,6 +1401,8 @@ class DocMirror:
                 else:
                     new_right[prev] = nr
                 prev = nr
+        self.list_next = new_right.tolist()
+        self.head_of_seg = new_heads[: self.n_segs].tolist()
         return new_right, new_deleted, new_heads
 
     def _renumber(self, keep: list[int], new_of_old: np.ndarray) -> None:

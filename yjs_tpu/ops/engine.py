@@ -81,13 +81,33 @@ def _block_levels(n_docs: int, w_lv: int) -> int:
     return _bucket(max(1, _BLOCK_BUDGET // max(1, n_docs * w_lv)), 1)
 
 
+# resident immutable device columns, in packed-row order for the one-
+# transfer statics scatter (client_key rides bitcast through the i32 pack)
+_STATIC_COLS = (
+    ("client_key", 0, "uint32"),
+    ("origin_slot", NULL, "int32"),
+    ("origin_clock", 0, "int32"),
+    ("right_slot", NULL, "int32"),
+    ("right_clock", 0, "int32"),
+    ("origin_row", NULL, "int32"),
+)
+
 if HAS_JAX:
     import functools
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _scatter_statics(statics, d, r, vals):
-        """All six resident-column updates in ONE device dispatch."""
-        return {k: statics[k].at[d, r].set(vals[k]) for k in statics}
+    def _scatter_statics(statics, packed):
+        """All six resident-column updates in ONE device dispatch from ONE
+        packed [8, K] i32 transfer (rows: doc idx, row idx, then the six
+        value columns in _STATIC_COLS order)."""
+        d, r = packed[0], packed[1]
+        out = {}
+        for j, (key, _fill, dtype) in enumerate(_STATIC_COLS):
+            v = packed[2 + j]
+            if dtype == "uint32":
+                v = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            out[key] = statics[key].at[d, r].set(v)
+        return out
 
 
 def _phase(name: str):
@@ -275,14 +295,7 @@ class BatchEngine:
 
     # -- device state management -------------------------------------------
 
-    _STATIC_COLS = (
-        ("client_key", 0, jnp.uint32),
-        ("origin_slot", NULL, jnp.int32),
-        ("origin_clock", 0, jnp.int32),
-        ("right_slot", NULL, jnp.int32),
-        ("right_clock", 0, jnp.int32),
-        ("origin_row", NULL, jnp.int32),
-    )
+    _STATIC_COLS = _STATIC_COLS
 
     def _ensure_capacity(self, n_rows: int, n_segs: int) -> None:
         cap = _bucket(n_rows)
@@ -308,29 +321,51 @@ class BatchEngine:
         self._right = self._put_b(new_right)
         self._deleted = self._put_b(new_deleted)
         self._starts = self._put_b(new_starts)
-        # grow the resident statics device-side (pad, no host round trip)
-        old_statics = self._statics
-        self._statics = {}
-        for key, fill, dtype in self._STATIC_COLS:
-            if old_statics is not None:
+        # grow the resident statics device-side (pad, no host round trip).
+        # Allocation is lazy: the bulk-apply path never reads them on
+        # device, so an apply-only engine spends no HBM or transfer on
+        # statics at all (_ensure_statics allocates on first levels/seq
+        # dispatch).
+        if self._statics is not None:
+            old_statics = self._statics
+            self._statics = {}
+            for key, fill, dtype in self._STATIC_COLS:
                 self._statics[key] = jnp.pad(
                     old_statics[key],
                     ((0, 0), (0, self._cap - old_cap)),
                     constant_values=fill,
                 )
-            else:
-                self._statics[key] = self._put_b(
-                    np.full((b, self._cap + 1), fill, np.dtype(dtype))
-                )
+
+    def _ensure_statics(self) -> None:
+        if self._statics is not None:
+            return
+        b = self.n_docs
+        self._statics = {
+            key: self._put_b(np.full((b, self._cap + 1), fill, np.dtype(dtype)))
+            for key, fill, dtype in self._STATIC_COLS
+        }
+        # everything must (re-)upload into the fresh arrays
+        self._uploaded_rows = [0] * b
 
     def _upload_statics(self, plans) -> None:
-        """Scatter this flush's NEW/changed rows into the resident statics.
+        """Scatter this flush's statics delta (its own dispatch — the
+        levels/seq paths; the bulk path fuses the delta into
+        kernels.apply_plan2 instead)."""
+        self._ensure_statics()
+        packed = self._statics_delta(plans)
+        if packed is not None:
+            self._statics = _scatter_statics(
+                self._statics, self._put_r(packed)
+            )
+
+    def _statics_delta(self, plans):
+        """This flush's NEW/changed rows as one packed [8, K] i32 block
+        (doc, row, six value columns; client_key bitcast).
 
         A doc's immutable columns only change by appending rows — except
         when a pre-split cuts an existing run (origin_row coverage moves to
         the new fragment) or compaction renumbered the table, which both
-        force a full re-upload of that doc.  One batched scatter per column
-        carries every active doc's delta."""
+        force a full re-upload of that doc."""
         doc_idx: list[np.ndarray] = []
         row_idx: list[np.ndarray] = []
         vals: dict[str, list[np.ndarray]] = {k: [] for k, _f, _d in self._STATIC_COLS}
@@ -347,31 +382,28 @@ class BatchEngine:
                 vals[k].append(cols[k])
             self._uploaded_rows[i] = n
         if not doc_idx:
-            return
+            return None
         d = np.concatenate(doc_idx)
         r = np.concatenate(row_idx)
         # pad to a power-of-two bucket so the scatter compiles once per
         # bucket, not once per delta size; padding lanes write the scratch
-        # row (index cap) of doc 0, whose contents are never read
+        # row (index cap) of doc 0, whose contents are never read.  ONE
+        # packed [8, K] transfer: per-array transfers each pay full link
+        # latency on tunneled backends.
         total = len(d)
         padded = _bucket(total, 64)
-        if padded > total:
-            pad = padded - total
-            d = np.concatenate([d, np.zeros(pad, np.int32)])
-            r = np.concatenate(
-                [r, np.full(pad, self._cap, np.int32)]
-            )
-        vpad = {}
-        for k, fill, dtype in self._STATIC_COLS:
+        packed = np.empty((2 + len(self._STATIC_COLS), padded), np.int32)
+        packed[0, :total] = d
+        packed[0, total:] = 0
+        packed[1, :total] = r
+        packed[1, total:] = self._cap
+        for j, (k, fill, dtype) in enumerate(self._STATIC_COLS):
             v = np.concatenate(vals[k])
-            if padded > total:
-                v = np.concatenate(
-                    [v, np.full(padded - total, fill, v.dtype)]
-                )
-            vpad[k] = self._put_r(v)
-        self._statics = _scatter_statics(
-            self._statics, self._put_r(d), self._put_r(r), vpad
-        )
+            if dtype == "uint32":
+                v = v.astype(np.uint32).view(np.int32)
+            packed[2 + j, :total] = v
+            packed[2 + j, total:] = fill
+        return packed
 
     # -- compaction ---------------------------------------------------------
 
@@ -429,6 +461,13 @@ class BatchEngine:
         pre_svs: dict[int, dict[int, int]] = {}
         demoted_now = 0
         emitting = bool(self._update_listeners)
+        # kernel selection: "apply" (default) ships the planner's final
+        # link values in one conflict-free scatter; "levels"/"seq" run
+        # YATA on device (the sharded step uses the levels form)
+        mode = os.environ.get("YTPU_KERNEL")
+        if not mode:
+            mode = "levels" if self._sharded_step is not None else "apply"
+        want_levels = mode != "apply" or self._sharded_step is not None
         with _phase("plan"):
             for i, m in enumerate(self.mirrors):
                 if i in self.fallback:
@@ -438,7 +477,7 @@ class BatchEngine:
                 if emitting:
                     pre_svs[i] = m.state_vector()
                 try:
-                    plans[i] = m.prepare_step()
+                    plans[i] = m.prepare_step(want_levels=want_levels)
                 except UnsupportedUpdate as e:
                     self._demote(i, pre_svs.get(i), reason=str(e))
                     demoted_now += 1
@@ -466,6 +505,9 @@ class BatchEngine:
         if not plans:
             metrics["t_total_s"] = time.perf_counter() - t_start
             self.last_flush_metrics = metrics
+            return
+        if mode == "apply" and self._sharded_step is None:
+            self._flush_apply(plans, pre_svs, emitting, metrics, t_start, t_plan)
             return
         with _phase("pack"):
             n_splits = _bucket(
@@ -522,7 +564,7 @@ class BatchEngine:
         t_pack = time.perf_counter()
         with _phase("dispatch"):
             dyn = (self._right, self._deleted, self._starts)
-            if os.environ.get("YTPU_KERNEL") == "seq":
+            if mode == "seq":
                 self._metrics_dev = None  # no sharded counters this flush
                 dyn = kernels.batch_step(
                     statics, dyn, self._put_b(splits), self._put_b(sched),
@@ -575,22 +617,7 @@ class BatchEngine:
         t_dispatch = time.perf_counter()
 
         with _phase("emit"):
-            # compact long demotion-replay logs: once a doc's integrated
-            # state is pending-free, its own columnar export supersedes the
-            # raw update prefix.  After the dispatch so the O(doc) host
-            # encode overlaps device execution; amortized by the threshold
-            for i in plans:
-                m = self.mirrors[i]
-                if len(self._update_log[i]) > 64 and not m.has_pending():
-                    self._update_log[i] = [(m.encode_state_as_update(), False)]
-
-            # doc.on('update') seam: emit each doc's flush novelty
-            # (host-side data only — overlaps the async device dispatch)
-            if emitting:
-                for i, p in plans.items():
-                    u = self.mirrors[i].encode_step_update(pre_svs[i], p)
-                    if u is not None:
-                        self._emit(i, u)
+            self._emit_phase(plans, pre_svs, emitting)
         t_emit = time.perf_counter()
 
         n_sched_entries = sum(len(p.sched8) for p in plans.values())
@@ -608,6 +635,128 @@ class BatchEngine:
             "level_width": w_lv,
             # fraction of the padded [B, L, W] schedule that is real work
             "schedule_occupancy": n_sched_entries / lv_slots if lv_slots else 0.0,
+            "n_pending_docs": len(pending_docs),
+            "pending_depth": sum(
+                self.mirrors[i].pending_depth() for i in pending_docs
+            ),
+            "t_pack_s": t_pack - t_plan,
+            "t_dispatch_s": t_dispatch - t_pack,
+            "t_emit_s": t_emit - t_dispatch,
+            "t_total_s": t_emit - t_start,
+        })
+        self.last_flush_metrics = metrics
+
+    def _emit_phase(self, plans, pre_svs, emitting) -> None:
+        """Post-dispatch host work shared by both dispatch paths: update-log
+        compaction + doc.on('update') novelty emission (overlaps the async
+        device execution)."""
+        for i in plans:
+            m = self.mirrors[i]
+            if len(self._update_log[i]) > 64 and not m.has_pending():
+                self._update_log[i] = [(m.encode_state_as_update(), False)]
+        if emitting:
+            for i, p in plans.items():
+                u = self.mirrors[i].encode_step_update(pre_svs[i], p)
+                if u is not None:
+                    self._emit(i, u)
+
+    def _flush_apply(self, plans, pre_svs, emitting, metrics, t_start, t_plan):
+        """Bulk-apply dispatch: ship the planner's final link/head/delete
+        values in ONE conflict-free scatter per array (host-resolved YATA;
+        see DocMirror._list_insert / plancore.cpp list_insert)."""
+        with _phase("pack"):
+            max_rows = max((p.n_rows for p in plans.values()), default=0)
+            max_segs = max((self.mirrors[i].n_segs for i in plans), default=0)
+            self._ensure_capacity(max_rows, max_segs)
+            b = self.n_docs
+            oob_r = np.int32(self._cap + 1)
+            # per-doc counts ride in the lanes header; doc ids and dense
+            # row indices are derived ON DEVICE (kernels.apply_plan2), so
+            # the transfer carries the minimum: full-table ("dense") link
+            # loads ship values only
+            counts = np.zeros((4, b), np.int32)
+            dense, sp_r, sp_v, hd_s, hd_v, dl_r = [], [], [], [], [], []
+            for i, p in plans.items():
+                k = len(p.link_rows)
+                rows = np.asarray(p.link_rows, np.int32)
+                vals = np.asarray(p.link_vals, np.int32)
+                if k and k == p.n_rows and rows[-1] == k - 1:
+                    counts[0, i] = k
+                    dense.append(vals)
+                elif k:
+                    counts[1, i] = k
+                    sp_r.append(rows)
+                    sp_v.append(vals)
+                hn = len(p.head_segs)
+                if hn:
+                    counts[2, i] = hn
+                    hd_s.append(np.asarray(p.head_segs, np.int32))
+                    hd_v.append(np.asarray(p.head_vals, np.int32))
+                dn = len(p.delete_rows)
+                if dn:
+                    counts[3, i] = dn
+                    dl_r.append(np.asarray(p.delete_rows, np.int32))
+
+            def sect(parts, pad_val, minimum=64):
+                flat = (
+                    np.concatenate(parts) if parts else np.zeros(0, np.int32)
+                )
+                total = len(flat)
+                k = _bucket(total, minimum)
+                if k > total:
+                    flat = np.concatenate(
+                        [flat, np.full(k - total, pad_val, np.int32)]
+                    )
+                return flat, k, total
+
+            dense_f, k_dn, n_dense = sect(dense, NULL)
+            spr_f, k_sp, n_sparse = sect(sp_r, oob_r)
+            spv_f = np.concatenate(sp_v) if sp_v else np.zeros(0, np.int32)
+            spv_f = np.concatenate(
+                [spv_f, np.full(k_sp - len(spv_f), NULL, np.int32)]
+            ) if k_sp > len(spv_f) else spv_f
+            hds_f, k_h, n_heads = sect(hd_s, np.int32(self._seg_cap + 1), 8)
+            hdv_f = np.concatenate(hd_v) if hd_v else np.zeros(0, np.int32)
+            hdv_f = np.concatenate(
+                [hdv_f, np.full(k_h - len(hdv_f), NULL, np.int32)]
+            ) if k_h > len(hdv_f) else hdv_f
+            dlr_f, k_d, n_dels = sect(dl_r, oob_r)
+            lanes = np.concatenate(
+                [counts.ravel(), dense_f, spr_f, spv_f, hds_f, hdv_f, dlr_f]
+            )
+            # the apply path never reads the device statics; mark touched
+            # docs for full (re-)upload if a levels/seq flush ever runs
+            for i in plans:
+                self._uploaded_rows[i] = 0
+        t_pack = time.perf_counter()
+        with _phase("dispatch"):
+            self._metrics_dev = None
+            dyn = (self._right, self._deleted, self._starts)
+            self._right, self._deleted, self._starts = kernels.apply_plan2(
+                dyn, self._put_r(lanes), k_dn, k_sp, k_h, k_d
+            )
+        t_dispatch = time.perf_counter()
+        with _phase("emit"):
+            self._emit_phase(plans, pre_svs, emitting)
+        t_emit = time.perf_counter()
+
+        lanes_padded = k_dn + k_sp + k_h + k_d
+        lanes_real = n_dense + n_sparse + n_heads + n_dels
+        pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
+        metrics.update({
+            "n_docs_flushed": sum(
+                1
+                for p in plans.values()
+                if len(p.link_rows) or len(p.head_segs) or len(p.delete_rows)
+            ),
+            "n_rows_max": max_rows,
+            "n_sched_entries": n_dense + n_sparse,
+            "n_levels": 1,
+            "level_width": n_dense + n_sparse,
+            # bulk path: fraction of dispatched scatter lanes that are real
+            "schedule_occupancy": (
+                lanes_real / lanes_padded if lanes_padded else 0.0
+            ),
             "n_pending_docs": len(pending_docs),
             "pending_depth": sum(
                 self.mirrors[i].pending_depth() for i in pending_docs
@@ -910,6 +1059,21 @@ class BatchEngine:
                     write_state_vector(e, sv)
                     enc_sv = e.to_bytes()
                 replies[j] = self.encode_state_as_update(i, enc_sv, v2=v2)
+        # native mirrors answer straight from the C++ columns: one
+        # ymx_encode_diff call per request, no device round trip (the
+        # device diff kernel still serves Python-mirror engines and can be
+        # forced with YTPU_SYNC_DEVICE=1)
+        if not v2 and not os.environ.get("YTPU_SYNC_DEVICE"):
+            rest = []
+            for j, i, sv in dev:
+                m = self.mirrors[i]
+                enc = getattr(m, "encode_diff_update", None)
+                u = enc(sv) if enc is not None else None
+                if u is None:
+                    rest.append((j, i, sv))
+                else:
+                    replies[j] = u
+            dev = rest
         if dev:
             docs = [i for _, i, _ in dev]
             row_slot, row_clock, row_end = self._sync_columns(docs)

@@ -77,26 +77,74 @@ class NativePlan:
     ``[L, W, 8]`` int32 block level-major (vectorized, no per-entry
     Python)."""
 
-    def __init__(self, lib, h, counts):
-        (self.n_rows, n_splits, n_sched, n_s8, self.n_levels,
-         self.max_width, n_del, n_ads) = (int(x) for x in counts[:8])
+    def __init__(self, lib, h, counts, mirror):
+        (self.n_rows, n_splits, n_sched, self._n_s8, self.n_levels,
+         self.max_width, n_del, self._n_ads) = (int(x) for x in counts[:8])
+        n_links, n_heads = int(counts[12]), int(counts[13])
+        self._lib, self._h = lib, h
+        # staleness guard for lazy sections: the C++ plan buffers are
+        # overwritten by the mirror's next prepare
+        self._mirror = mirror
+        self._seq = mirror._plan_seq
+        self._n_sched = n_sched
+        # hot-path sections fetched eagerly (the bulk apply + split count)
         self.splits = np.empty((n_splits, 2), np.int64)
-        self.sched = np.empty((n_sched, 4), np.int64)
-        self.sched8 = np.empty((n_s8, 8), np.int64)
-        self.levels = np.empty(n_s8, np.int64)
         self.delete_rows = np.empty(n_del, np.int64)
-        ads = np.empty((n_ads, 3), np.int64)
+        self.link_rows = np.empty(n_links, np.int64)
+        self.link_vals = np.empty(n_links, np.int64)
+        self.head_segs = np.empty(n_heads, np.int64)
+        self.head_vals = np.empty(n_heads, np.int64)
         if n_splits:
             lib.ymx_plan_splits(h, _p64(self.splits))
-        if n_sched:
-            lib.ymx_plan_sched(h, _p64(self.sched))
-        if n_s8:
-            lib.ymx_plan_sched8(h, _p64(self.sched8), _p64(self.levels))
         if n_del:
             lib.ymx_plan_deletes(h, _p64(self.delete_rows))
-        if n_ads:
-            lib.ymx_plan_applied_ds(h, _p64(ads))
-        self.applied_ds = [tuple(row) for row in ads.tolist()]
+        if n_links:
+            lib.ymx_plan_links(h, _p64(self.link_rows), _p64(self.link_vals))
+        if n_heads:
+            lib.ymx_plan_heads(h, _p64(self.head_segs), _p64(self.head_vals))
+        self._sched = self._sched8 = self._levels = self._applied = None
+
+    def _fresh(self):
+        if self._seq != self._mirror._plan_seq:
+            raise RuntimeError(
+                "stale NativePlan: the mirror ran another prepare_step"
+            )
+
+    @property
+    def sched(self):
+        if self._sched is None:
+            self._fresh()
+            self._sched = np.empty((self._n_sched, 4), np.int64)
+            if self._n_sched:
+                self._lib.ymx_plan_sched(self._h, _p64(self._sched))
+        return self._sched
+
+    @property
+    def sched8(self):
+        if self._sched8 is None:
+            self._fresh()
+            self._sched8 = np.empty((self._n_s8, 8), np.int64)
+            self._levels = np.empty(self._n_s8, np.int64)
+            if self._n_s8:
+                self._lib.ymx_plan_sched8(
+                    self._h, _p64(self._sched8), _p64(self._levels)
+                )
+        return self._sched8
+
+    @property
+    def levels(self):
+        self.sched8
+        return self._levels
+
+    @property
+    def applied_ds(self):
+        if self._applied is None:
+            self._fresh()
+            ads = np.empty((self._n_ads, 3), np.int64)
+            if self._n_ads:
+                self._lib.ymx_plan_applied_ds(self._h, _p64(ads))
+            self._applied = [tuple(row) for row in ads.tolist()]
+        return self._applied
 
     def pack_into(self, block: np.ndarray) -> None:
         if not len(self.sched8):
@@ -133,6 +181,7 @@ class NativeMirror:
         # spill/encode paths realize through the descriptor columns
         self._py.realized_content = self.realized_content
         self._synced_gen = -1
+        self._plan_seq = 0
         # extra per-row source columns the shadow DocMirror has no slot for
         self._src_ofs2: list[int] = []
         self._src_end2: list[int] = []
@@ -150,7 +199,11 @@ class NativeMirror:
     def ingest(self, update: bytes, v2: bool = False) -> None:
         self._incoming.append((update, v2))
 
-    def prepare_step(self) -> NativePlan:
+    def prepare_step(self, want_levels: bool | None = None) -> NativePlan:
+        # default matches DocMirror: compute the full plan (level schedule
+        # included); the engine passes want_levels=False on the bulk path
+        if want_levels is None:
+            want_levels = True
         lib, h = self._lib, self._h
         staged = self._incoming
         n_up = len(staged)
@@ -164,9 +217,13 @@ class NativeMirror:
             self._py_bufs[int(bid)] = (u, arr)
             ids[j] = bid
             v2s[j] = 1 if v2 else 0
-        counts = np.zeros(12, np.int64)
-        rc = lib.ymx_prepare(h, _p64(ids), _p64(v2s), n_up, _p64(counts))
+        counts = np.zeros(14, np.int64)
+        rc = lib.ymx_prepare(
+            h, _p64(ids), _p64(v2s), n_up, 1 if want_levels else 0,
+            _p64(counts),
+        )
         self._incoming = []
+        self._plan_seq += 1
         if rc == -9:
             raise UnsupportedUpdate("subdocument (content ref 9)")
         if rc != 0:
@@ -188,7 +245,7 @@ class NativeMirror:
                 raise
             raise UnsupportedUpdate(f"native plan: unsupported payload (rc={rc})")
         self._realized.clear()
-        return NativePlan(lib, h, counts)
+        return NativePlan(lib, h, counts, self)
 
     @property
     def n_rows(self) -> int:
@@ -258,6 +315,62 @@ class NativeMirror:
             new_del[:n_new].astype(bool),
             new_heads,
         )
+
+    # -- native wire encodes -------------------------------------------------
+
+    def encode_diff_update(
+        self, target_sv: dict[int, int] | None, ds_ranges=None
+    ) -> bytes | None:
+        """The doc's diff against ``target_sv`` encoded fully natively
+        (reference encodeStateAsUpdate, encoding.js:490-526); ``ds_ranges``
+        overrides the DS section (the flush-novelty form).  Returns None
+        when the native writer cannot serve it (V2-framed payloads in the
+        selection) — callers fall back to the shadow's encode."""
+        lib, h = self._lib, self._h
+        sv = target_sv or {}
+        n_sv = len(sv)
+        svc = np.fromiter(sv.keys(), np.int64, n_sv) if n_sv else np.zeros(1, np.int64)
+        svk = np.fromiter(sv.values(), np.int64, n_sv) if n_sv else np.zeros(1, np.int64)
+        if ds_ranges is None:
+            ds = np.zeros(3, np.int64)
+            n_ds, override = 0, 0
+        else:
+            n_ds = len(ds_ranges)
+            ds = (
+                np.asarray(ds_ranges, np.int64).reshape(-1)
+                if n_ds
+                else np.zeros(3, np.int64)
+            )
+            override = 1
+        out = np.empty(int(lib.ymx_encode_bound(h)), np.uint8)
+        rc = int(
+            lib.ymx_encode_diff(
+                h, _p64(svc), _p64(svk), n_sv, _p64(ds), n_ds,
+                override, out.ctypes.data_as(_u8p),
+                ctypes.c_uint64(len(out)),
+            )
+        )
+        if rc < 0:
+            return None
+        return out[:rc].tobytes()
+
+    def encode_state_as_update(self, target_sv=None, v2: bool = False) -> bytes:
+        if not v2:
+            u = self.encode_diff_update(target_sv)
+            if u is not None:
+                return u
+        self._sync()
+        return DocMirror.encode_state_as_update(self._py, target_sv, v2=v2)
+
+    def encode_step_update(self, pre_sv, plan, v2: bool = False) -> bytes | None:
+        if not v2:
+            u = self.encode_diff_update(pre_sv, ds_ranges=plan.applied_ds)
+            if u is not None:
+                # header-only update (0 struct groups, 0 DS clients) means
+                # the flush produced no novelty — match the None contract
+                return None if u == b"\x00\x00" else u
+        self._sync()
+        return DocMirror.encode_step_update(self._py, pre_sv, plan, v2=v2)
 
     # -- content realization -------------------------------------------------
 
@@ -358,6 +471,11 @@ class NativeMirror:
         py.client_of_slot = clients[:ns].tolist()
         py.slot_of_client = {c: i for i, c in enumerate(py.client_of_slot)}
         py.state = state[:ns].tolist()
+        # host list state (the device right_link/starts mirror)
+        links = np.empty(max(1, n), np.int64)
+        if n:
+            lib.ymx_links(h, _p64(links))
+        py.list_next = links[:n]
         # fragment index: straight memcpy of the C++ index (already sorted)
         counts = np.zeros(max(1, ns), np.int64)
         if ns:
@@ -384,6 +502,10 @@ class NativeMirror:
                 ("name_ofs", "name_len", "sub_ofs", "sub_len", "parent")}
         if nseg:
             lib.ymx_segs(h, *(_p64(segc[k]) for k in segc))
+        heads = np.empty(max(1, nseg), np.int64)
+        if nseg:
+            lib.ymx_heads(h, _p64(heads))
+        py.head_of_seg = heads[:nseg]
         py.seg_name_ofs = segc["name_ofs"][:nseg].tolist()
         py.seg_name_len = segc["name_len"][:nseg].tolist()
         py.seg_sub_ofs = segc["sub_ofs"][:nseg].tolist()
