@@ -159,6 +159,10 @@ class BatchEngine:
         # doc.on('update') seam: callbacks (doc_idx, update_bytes) invoked
         # after each flush with the flush's incremental update per doc
         self._update_listeners: list = []
+        # typed-event seam: doc idx -> callbacks(doc, events) where events
+        # are YEvent-shaped dicts computed from the step plan (reference
+        # observe/observeDeep, AbstractType.js:360-389)
+        self._event_listeners: dict[int, list] = {}
         self._metrics_dev: dict | None = None
         self._sharded_step = None
         # cached sharded state-vector callables keyed by n_slots (jit's
@@ -227,6 +231,8 @@ class BatchEngine:
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
         fb.on("update", lambda u, origin, d, i=doc: self._emit(i, u))
+        if doc in self._event_listeners:
+            self._attach_cpu_events(doc, fb)
         return fb
 
     def on_update(self, callback) -> None:
@@ -240,6 +246,55 @@ class BatchEngine:
     def off_update(self, callback) -> None:
         self._update_listeners.remove(callback)
 
+    def observe(self, doc: int, callback) -> None:
+        """Register ``callback(doc_idx, events)`` for one doc: after each
+        flush that changes it, ``events`` is a list of YEvent-shaped dicts
+        ``{"path", "delta", "keys"}`` — path[0] is the root type name,
+        deeper elements are map keys / list indices (reference
+        YEvent.path + YEvent.changes).  Demoted docs deliver the same
+        shape from the CPU core's transactions."""
+        self._event_listeners.setdefault(doc, []).append(callback)
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            self._attach_cpu_events(doc, fb)
+
+    def unobserve(self, doc: int, callback) -> None:
+        self._event_listeners[doc].remove(callback)
+        if not self._event_listeners[doc]:
+            del self._event_listeners[doc]
+
+    def _attach_cpu_events(self, doc: int, fb: Doc) -> None:
+        if getattr(fb, "_ytpu_events_attached", False):
+            return
+        fb._ytpu_events_attached = True
+        from ..ids import find_root_type_key
+        from ..types.events import YEvent, get_path_to
+
+        def after_transaction(transaction, d, i=doc):
+            cbs = self._event_listeners.get(i)
+            if not cbs:
+                return
+            events = []
+            for typ in transaction.changed:
+                root = typ
+                while root._item is not None:
+                    root = root._item.parent
+                ev = YEvent(typ, transaction)
+                changes = ev.changes
+                if not changes["delta"] and not changes["keys"]:
+                    continue
+                events.append({
+                    "path": [find_root_type_key(root)]
+                    + get_path_to(root, typ),
+                    "delta": changes["delta"],
+                    "keys": changes["keys"],
+                })
+            if events:
+                for cb in cbs:
+                    cb(i, events)
+
+        fb.on("afterTransaction", after_transaction)
+
     def _emit(self, doc: int, update: bytes) -> None:
         for cb in self._update_listeners:
             cb(doc, update)
@@ -250,10 +305,28 @@ class BatchEngine:
         pre_sv: dict[int, int] | None = None,
         reason: str = "unspecified",
     ) -> Doc:
-        """Move a doc to the CPU reference path by replaying its update log."""
+        """Move a doc to the CPU reference path by replaying its update log.
+
+        When the doc is observed, the CPU event bridge attaches at the
+        point of the replay where the pre-flush state vector is covered
+        (the log prefix reproduces it exactly), so the demoting flush's
+        own changes still deliver typed events — only historical replay
+        stays silent."""
         self.demotions.append({"doc": doc, "reason": reason})
         fb = Doc(gc=False)
+        observed = doc in self._event_listeners
+        attached = False
+        if observed and not pre_sv:
+            self._attach_cpu_events(doc, fb)
+            attached = True
         for update, v2 in self._update_log[doc]:
+            if observed and not attached:
+                from ..core import get_state_vector
+
+                sv = get_state_vector(fb.store)
+                if all(sv.get(c, 0) >= v for c, v in pre_sv.items()):
+                    self._attach_cpu_events(doc, fb)
+                    attached = True
             (apply_update_v2 if v2 else apply_update)(fb, update)
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
@@ -275,6 +348,8 @@ class BatchEngine:
             if novelty:
                 self._emit(doc, novelty)
         fb.on("update", lambda u, origin, d, i=doc: self._emit(i, u))
+        if doc in self._event_listeners:
+            self._attach_cpu_events(doc, fb)
         return fb
 
     # -- device placement ---------------------------------------------------
@@ -461,6 +536,7 @@ class BatchEngine:
         pre_svs: dict[int, dict[int, int]] = {}
         demoted_now = 0
         emitting = bool(self._update_listeners)
+        observing = self._event_listeners
         # kernel selection: "apply" (default) ships the planner's final
         # link values in one conflict-free scatter; "levels"/"seq" run
         # YATA on device (the sharded step uses the levels form)
@@ -474,7 +550,7 @@ class BatchEngine:
                     continue
                 if not m._incoming and not m.has_pending():
                     continue  # idle doc: nothing to plan, upload, or emit
-                if emitting:
+                if emitting or i in observing:
                     pre_svs[i] = m.state_vector()
                 try:
                     plans[i] = m.prepare_step(want_levels=want_levels)
@@ -659,6 +735,19 @@ class BatchEngine:
                 u = self.mirrors[i].encode_step_update(pre_svs[i], p)
                 if u is not None:
                     self._emit(i, u)
+        if self._event_listeners:
+            from .events import compute_flush_events
+
+            for i, p in plans.items():
+                cbs = self._event_listeners.get(i)
+                if not cbs:
+                    continue
+                events = compute_flush_events(
+                    self.mirrors[i], p, pre_svs[i]
+                )
+                if events:
+                    for cb in cbs:
+                        cb(i, events)
 
     def _flush_apply(self, plans, pre_svs, emitting, metrics, t_start, t_plan):
         """Bulk-apply dispatch: ship the planner's final link/head/delete
@@ -846,6 +935,56 @@ class BatchEngine:
             return ""
         rows, dels = self._order(doc, seg)
         return visible_text(m, rows, dels)
+
+    def to_delta(self, doc: int, name: str | None = None) -> list:
+        """Attributed rich-text delta of one root text type, straight from
+        the mirror (reference YText.toDelta, YText.js:936-1030): format
+        runs toggle current_attributes, strings/embeds emit insert ops —
+        no CPU-doc replay needed for rich-text consumers."""
+        name = name or self.root_name
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return fb.get_text(name).to_delta()
+        from ..core import ContentEmbed, ContentFormat, ContentString
+        from ..types.ytext import update_current_attributes
+
+        m = self.mirrors[doc]
+        seg = m.segments.get((name, None, NULL))
+        if seg is None:
+            return []
+        ops: list = []
+        cur: dict = {}
+        parts: list[str] = []
+
+        def pack_str():
+            if parts:
+                op = {"insert": from_u16("".join(parts))}
+                if cur:
+                    op["attributes"] = dict(cur)
+                ops.append(op)
+                parts.clear()
+
+        deleted = m._host_deleted_rows
+        nxt = m.list_next
+        r = m.head_of_seg[seg]
+        while r != NULL:
+            r = int(r)
+            if r not in deleted:
+                c = m.realized_content(r)
+                if isinstance(c, ContentString):
+                    parts.append(c.str)
+                elif isinstance(c, ContentEmbed):
+                    pack_str()
+                    op = {"insert": c.embed}
+                    if cur:
+                        op["attributes"] = dict(cur)
+                    ops.append(op)
+                elif isinstance(c, ContentFormat):
+                    pack_str()
+                    update_current_attributes(cur, c)
+            r = nxt[r]
+        pack_str()
+        return ops
 
     def map_json(self, doc: int, name: str | None = None) -> dict:
         """The visible {key: value} content of one root YMap (LWW winners,

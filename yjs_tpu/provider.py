@@ -75,6 +75,29 @@ class TpuProvider:
             lambda doc, update: callback(self._guid_of[doc], update)
         )
 
+    def observe(self, guid: str, path, callback):
+        """Register ``callback(guid, event)`` for events whose path starts
+        with ``path`` (a sequence; ``[]`` = every type in the room;
+        ``["text"]`` = the root text).  Events are YEvent-shaped dicts
+        ``{"path", "delta", "keys"}`` computed from each flush's step plan
+        (reference observe/observeDeep + YEvent.changes) — the server-side
+        "what changed in room X" seam without replaying into a CPU doc.
+        Returns an unsubscribe callable."""
+        prefix = list(path)
+
+        def bridge(doc, events, g=guid):
+            for ev in events:
+                if ev["path"][: len(prefix)] == prefix:
+                    callback(g, ev)
+
+        doc = self.doc_id(guid)
+        self.engine.observe(doc, bridge)
+
+        def unobserve():
+            self.engine.unobserve(doc, bridge)
+
+        return unobserve
+
     # -- update plumbing ----------------------------------------------------
 
     def receive_update(self, guid: str, update: bytes, v2: bool = False) -> None:
@@ -165,6 +188,12 @@ class TpuProvider:
     def text(self, guid: str) -> str:
         self.flush()
         return self.engine.text(self.doc_id(guid))
+
+    def to_delta(self, guid: str) -> list:
+        """Attributed rich-text delta of the room's root text (reference
+        YText.toDelta) — served from the mirror, no CPU replay."""
+        self.flush()
+        return self.engine.to_delta(self.doc_id(guid))
 
     def state_vector(self, guid: str) -> dict[int, int]:
         self.flush()
