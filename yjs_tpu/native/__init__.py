@@ -165,6 +165,9 @@ def load():
         lib.ymx_encode_diff.restype = i64
         lib.ymx_encode_diff.argtypes = [vp, i64p, i64p, i64, i64p, i64,
                                         ctypes.c_int, u8p, u64]
+        lib.ymx_encode_diff_v2.restype = i64
+        lib.ymx_encode_diff_v2.argtypes = [vp, i64p, i64p, i64, i64p, i64,
+                                           ctypes.c_int, u8p, u64]
         lib.ymx_compact.restype = i64
         lib.ymx_compact.argtypes = [vp, i32p, u8p, i32p, i64, ctypes.c_int,
                                     i32p, u8p, i32p, i64]

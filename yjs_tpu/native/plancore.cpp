@@ -1539,6 +1539,421 @@ struct Mirror {
   }
 };
 
+// shared by the V1/V2 diff writers: remote state per slot, slot order
+// (descending client), and the DS section groups
+struct DiffPrep {
+  std::vector<int64_t> remote;
+  std::vector<size_t> slot_order;
+  std::vector<int64_t> dg_client, dg_start, dg_len, d_clock, d_len;
+};
+
+inline void build_diff_prep(Mirror* m, const int64_t* sv_clients,
+                            const int64_t* sv_clocks, int64_t n_sv,
+                            const int64_t* ds_ranges, int64_t n_ds_override,
+                            int ds_override, DiffPrep* p) {
+  size_t n_slots = m->client_of_slot.size();
+  p->remote.assign(n_slots, 0);
+  for (int64_t i = 0; i < n_sv; i++) {
+    auto it = m->slot_of_client.find(sv_clients[i]);
+    if (it != m->slot_of_client.end())
+      p->remote[(size_t)it->second] = sv_clocks[i];
+  }
+  p->slot_order.resize(n_slots);
+  for (size_t s = 0; s < n_slots; s++) p->slot_order[s] = s;
+  std::sort(p->slot_order.begin(), p->slot_order.end(),
+            [&](size_t a, size_t b) {
+              return m->client_of_slot[a] > m->client_of_slot[b];
+            });
+  auto push_union = [&](int64_t client,
+                        std::vector<std::array<int64_t, 2>>& ranges) {
+    std::sort(ranges.begin(), ranges.end());
+    size_t start = p->d_clock.size();
+    for (auto& [ck, ln] : ranges) {
+      if (p->d_clock.size() > start &&
+          ck <= p->d_clock.back() + p->d_len.back()) {
+        p->d_len.back() =
+            std::max(p->d_len.back(), ck + ln - p->d_clock.back());
+      } else {
+        p->d_clock.push_back(ck);
+        p->d_len.push_back(ln);
+      }
+    }
+    if (p->d_clock.size() > start) {
+      p->dg_client.push_back(client);
+      p->dg_start.push_back((int64_t)start);
+      p->dg_len.push_back((int64_t)(p->d_clock.size() - start));
+    }
+  };
+  if (ds_override) {
+    std::vector<int64_t> order;
+    std::unordered_map<int64_t, std::vector<std::array<int64_t, 2>>> by;
+    for (int64_t i = 0; i < n_ds_override; i++) {
+      int64_t cl = ds_ranges[i * 3];
+      if (!by.count(cl)) order.push_back(cl);
+      by[cl].push_back({{ds_ranges[i * 3 + 1], ds_ranges[i * 3 + 2]}});
+    }
+    for (int64_t cl : order) push_union(cl, by[cl]);
+  } else {
+    for (int64_t slot : m->ds_slot_order) {
+      auto ranges = m->ds[slot];  // copy: union sorts
+      push_union(m->client_of_slot[(size_t)slot], ranges);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// native V2 wire writer: the 9-stream columnar container (reference
+// UpdateEncoder.js:264-408; byte-identical to yjs_tpu/coding.py
+// UpdateEncoderV2, including the never-populated key_map quirk)
+// ---------------------------------------------------------------------------
+
+struct VecW {
+  std::vector<uint8_t> b;
+  void u8(uint8_t x) { b.push_back(x); }
+  void varuint(uint64_t n) {
+    while (n > 0x7f) { b.push_back(0x80 | (n & 0x7f)); n >>= 7; }
+    b.push_back((uint8_t)n);
+  }
+  // lib0 signed varint (sign-magnitude, 6 bits in the first byte)
+  void varint(int64_t num, bool neg_zero = false) {
+    bool neg = num < 0 || neg_zero;
+    uint64_t n = neg ? (uint64_t)(-num) : (uint64_t)num;
+    b.push_back((n > 0x3f ? 0x80 : 0) | (neg ? 0x40 : 0) | (n & 0x3f));
+    n >>= 6;
+    while (n > 0) { b.push_back((n > 0x7f ? 0x80 : 0) | (n & 0x7f)); n >>= 7; }
+  }
+  void bytes(const uint8_t* p, size_t n) { b.insert(b.end(), p, p + n); }
+};
+
+struct RleW {  // lib0 RleEncoder over write_uint8 (no trailing count)
+  VecW o;
+  int64_t s = 0, count = 0;
+  void write(int64_t v) {
+    if (s == v && count > 0) { count++; return; }
+    if (count > 0) o.varuint((uint64_t)(count - 1));
+    count = 1;
+    o.u8((uint8_t)v);
+    s = v;
+  }
+};
+
+struct UintOptW {  // lib0 UintOptRleEncoder
+  VecW o;
+  int64_t s = 0, count = 0;
+  void write(int64_t v) {
+    if (s == v) { count++; return; }
+    flush();
+    count = 1;
+    s = v;
+  }
+  void flush() {
+    if (count > 0) {
+      if (count == 1) o.varint(s);
+      else { o.varint(-s, s == 0); o.varuint((uint64_t)(count - 2)); }
+    }
+  }
+};
+
+struct IntDiffOptW {  // lib0 IntDiffOptRleEncoder
+  VecW o;
+  int64_t s = 0, count = 0, diff = 0;
+  void write(int64_t v) {
+    if (diff == v - s) { s = v; count++; return; }
+    flush();
+    count = 1;
+    diff = v - s;
+    s = v;
+  }
+  void flush() {
+    if (count > 0) {
+      o.varint(diff * 2 + (count == 1 ? 0 : 1));
+      if (count > 1) o.varuint((uint64_t)(count - 2));
+    }
+  }
+};
+
+inline int64_t utf16_len_of(const uint8_t* p, uint64_t n);
+
+struct StringW {  // lib0 StringEncoder: one UTF-8 arena + u16-length runs
+  std::vector<uint8_t> arena;
+  UintOptW lens;
+  // append a raw UTF-8 range; u16len = its UTF-16 unit count
+  void write(const uint8_t* p, size_t n, int64_t u16len) {
+    arena.insert(arena.end(), p, p + n);
+    lens.write(u16len);
+  }
+  // cut `off` UTF-16 units off the front (the partial-first-struct rule),
+  // with the surrogate-pair U+FFFD repair of write_cut_string
+  void write_cut(const uint8_t* s, uint64_t blen, int64_t off) {
+    uint64_t i = 0;
+    bool mid = false;
+    int64_t skipped = 0;
+    int64_t total = utf16_len_of(s, blen);
+    while (skipped < off && i < blen) {
+      uint8_t c = s[i];
+      if (c < 0x80) { skipped += 1; i += 1; }
+      else if (c < 0xE0) { skipped += 1; i += 2; }
+      else if (c < 0xF0) { skipped += 1; i += 3; }
+      else {
+        skipped += 2; i += 4;
+        if (skipped > off) mid = true;
+      }
+    }
+    if (mid) {  // the cut consumed a pair: emit the U+FFFD low half
+      static const uint8_t rep[3] = {0xEF, 0xBF, 0xBD};
+      arena.insert(arena.end(), rep, rep + 3);
+    }
+    arena.insert(arena.end(), s + i, s + blen);
+    lens.write(total - off);
+  }
+  void emit(VecW* out) {
+    // StringEncoder.to_bytes = var_string(arena) + RAW lens bytes, the
+    // whole thing wrapped in the container's var_uint8_array
+    UintOptW tmp = lens;  // copy: flush is destructive
+    tmp.flush();
+    VecW inner;
+    inner.varuint(arena.size());
+    inner.bytes(arena.data(), arena.size());
+    inner.bytes(tmp.o.b.data(), tmp.o.b.size());
+    out->varuint(inner.b.size());
+    out->bytes(inner.b.data(), inner.b.size());
+  }
+};
+
+struct V2W {
+  IntDiffOptW key_clock;
+  UintOptW client;
+  IntDiffOptW left_clock;
+  IntDiffOptW right_clock;
+  RleW info;
+  StringW str;
+  RleW parent_info;
+  UintOptW type_ref;
+  UintOptW len;
+  VecW rest;
+  int64_t key_counter = 0;
+
+  void write_left_id(int64_t c, int64_t k) { client.write(c); left_clock.write(k); }
+  void write_right_id(int64_t c, int64_t k) { client.write(c); right_clock.write(k); }
+  // the v13.4.9 write_key quirk: the dictionary is never populated, so
+  // every key emits a fresh clock AND the string (UpdateEncoder.js:399-407)
+  void write_key(const uint8_t* p, size_t n, int64_t u16len) {
+    key_clock.write(key_counter++);
+    str.write(p, n, u16len);
+  }
+
+  std::vector<uint8_t> finish() {
+    VecW out;
+    out.u8(0);  // feature flag
+    auto stream = [&](VecW& v) {
+      out.varuint(v.b.size());
+      out.bytes(v.b.data(), v.b.size());
+    };
+    auto opt = [&](UintOptW& e) { UintOptW t = e; t.flush(); stream(t.o); };
+    auto idf = [&](IntDiffOptW& e) { IntDiffOptW t = e; t.flush(); stream(t.o); };
+    idf(key_clock);
+    opt(client);
+    idf(left_clock);
+    idf(right_clock);
+    stream(info.o);
+    str.emit(&out);
+    stream(parent_info.o);
+    opt(type_ref);
+    opt(len);
+    out.bytes(rest.b.data(), rest.b.size());
+    return std::move(out.b);
+  }
+};
+
+inline int64_t utf16_len_of(const uint8_t* p, uint64_t n) {
+  int64_t u = 0;
+  for (uint64_t i = 0; i < n;) {
+    uint8_t c = p[i];
+    if (c < 0x80) { u += 1; i += 1; }
+    else if (c < 0xE0) { u += 1; i += 2; }
+    else if (c < 0xF0) { u += 1; i += 3; }
+    else { u += 2; i += 4; }
+  }
+  return u;
+}
+
+// full-native V2 sync encode (the V2 twin of mirror_encode_diff).
+// Returns the update bytes via `out` vector; -7 when the selection needs
+// the Python writer (V1-framed embed/format/type or spilled content).
+int64_t mirror_encode_diff_v2(Mirror* m, const int64_t* sv_clients,
+                              const int64_t* sv_clocks, int64_t n_sv,
+                              const int64_t* ds_ranges, int64_t n_ds_override,
+                              int ds_override,
+                              std::vector<uint8_t>* out_bytes) {
+  DiffPrep prep;
+  build_diff_prep(m, sv_clients, sv_clocks, n_sv, ds_ranges, n_ds_override,
+                  ds_override, &prep);
+  auto& remote = prep.remote;
+  auto& slot_order = prep.slot_order;
+  // selection per slot (rows in clock order via the frag index)
+  std::vector<std::pair<size_t, std::vector<int64_t>>> groups;
+  for (size_t si : slot_order) {
+    std::vector<int64_t> rows;
+    int64_t rem = remote[si];
+    for (int64_t r : m->frag_row[si])
+      if (m->r_clock[r] + m->r_len[r] > rem) rows.push_back(r);
+    if (!rows.empty()) groups.push_back({si, std::move(rows)});
+  }
+  // scope check first: fall back before writing anything
+  for (auto& [si, rows] : groups) {
+    for (int64_t r : rows) {
+      const ContentDesc& c = m->r_c[(size_t)r];
+      if (c.kind == kKindSpill) return -7;
+      if (c.kind == kKindFramed && m->r_ref[r] != 3) return -7;
+    }
+  }
+  V2W w;
+  w.rest.varuint(groups.size());
+  for (auto& [si, rows] : groups) {
+    int64_t rem = remote[si];
+    w.rest.varuint(rows.size());
+    w.client.write(m->client_of_slot[si]);
+    int64_t first_ofs = std::max<int64_t>(0, rem - m->r_clock[rows[0]]);
+    w.rest.varuint((uint64_t)(m->r_clock[rows[0]] + first_ofs));
+    bool first = true;
+    for (int64_t r : rows) {
+      int64_t ofs = first ? first_ofs : 0;
+      first = false;
+      const ContentDesc& c = m->r_c[(size_t)r];
+      int64_t ref = m->r_ref[r];
+      if (m->r_is_gc[r]) {
+        w.info.write(0);
+        w.len.write(m->r_len[r] - ofs);
+        continue;
+      }
+      int64_t oc = m->r_oslot[r] == kNull
+                       ? kNull
+                       : m->client_of_slot[(size_t)m->r_oslot[r]];
+      int64_t ok = m->r_oclock[r];
+      if (ofs > 0) { oc = m->client_of_slot[si]; ok = m->r_clock[r] + ofs - 1; }
+      int64_t rc = m->r_rslot[r] == kNull
+                       ? kNull
+                       : m->client_of_slot[(size_t)m->r_rslot[r]];
+      int64_t rk = m->r_rclock[r];
+      int64_t sg = m->r_seg[r];
+      int64_t ni = sg == kNull ? kNull : m->seg_name_id[sg];
+      int64_t sui = sg == kNull ? kNull : m->seg_sub_id[sg];
+      int64_t pr = sg == kNull ? kNull : m->seg_parent[sg];
+      uint8_t inf = (uint8_t)(ref & kBits5);
+      if (oc >= 0) inf |= kBit8;
+      if (rc >= 0) inf |= kBit7;
+      if (sui != kNull) inf |= kBit6;
+      w.info.write(inf);
+      if (oc >= 0) w.write_left_id(oc, ok);
+      if (rc >= 0) w.write_right_id(rc, rk);
+      if (oc < 0 && rc < 0) {
+        if (pr != kNull) {
+          w.parent_info.write(0);
+          w.write_left_id(
+              m->client_of_slot[(size_t)m->r_slot[(size_t)pr]],
+              m->r_clock[(size_t)pr]);
+        } else if (ni != kNull) {
+          w.parent_info.write(1);
+          const uint8_t* np = m->strings.data() + m->intern_ofs[(size_t)ni];
+          size_t nl = (size_t)m->intern_len[(size_t)ni];
+          w.str.write(np, nl, utf16_len_of(np, nl));
+        } else {
+          return -3;
+        }
+        if (sui != kNull) {
+          const uint8_t* sp = m->strings.data() + m->intern_ofs[(size_t)sui];
+          size_t sl = (size_t)m->intern_len[(size_t)sui];
+          w.str.write(sp, sl, utf16_len_of(sp, sl));
+        }
+      }
+      // content (write order matches the Python Content*.write methods)
+      switch (c.kind) {
+        case kKindDeleted:
+          w.len.write(m->r_len[r] - ofs);
+          break;
+        case kKindUtf8:
+          w.str.write_cut(m->buf_ptr(c.buf) + c.ofs,
+                          (uint64_t)(c.end - c.ofs), ofs);
+          break;
+        case kKindAnys: {  // write_len + element any bytes into rest
+          w.len.write(c.count - ofs);
+          Reader er{m->buf_ptr(c.buf), (uint64_t)c.end, (uint64_t)c.ofs,
+                    false};
+          for (int64_t i = 0; i < ofs && !er.fail; i++) er.skip_any();
+          if (er.fail) return -4;
+          w.rest.bytes(m->buf_ptr(c.buf) + er.pos,
+                       (size_t)(c.end - (int64_t)er.pos));
+          break;
+        }
+        case kKindJsons: {  // write_len + each element into the str stream
+          w.len.write(c.count - ofs);
+          Reader er{m->buf_ptr(c.buf), (uint64_t)c.end, (uint64_t)c.ofs,
+                    false};
+          for (int64_t i = 0; i < c.count && !er.fail; i++) {
+            uint64_t o, bl;
+            er.var_string(&o, &bl);
+            if (i >= ofs)
+              w.str.write(m->buf_ptr(c.buf) + o, (size_t)bl,
+                          utf16_len_of(m->buf_ptr(c.buf) + o, bl));
+          }
+          if (er.fail) return -4;
+          break;
+        }
+        case kKindFramed:  // ref 3 only (checked above): varuint+bytes
+          w.rest.bytes(m->buf_ptr(c.buf) + c.ofs, (size_t)(c.end - c.ofs));
+          break;
+        case kKindV2Lazy: {
+          if (ref == 5) {  // embed: any into rest
+            w.rest.bytes(m->buf_ptr(c.buf) + c.ofs,
+                         (size_t)(c.end - c.ofs));
+          } else if (ref == 6) {  // format: key via write_key, value any
+            const uint8_t* kp = m->buf_ptr(c.buf) + c.ofs;
+            size_t kl = (size_t)(c.end - c.ofs);
+            w.write_key(kp, kl, utf16_len_of(kp, kl));
+            w.rest.bytes(m->buf_ptr(c.buf) + c.ofs2,
+                         (size_t)(c.end2 - c.ofs2));
+          } else if (ref == 7) {  // type: type_ref (+ name via write_key)
+            w.type_ref.write(c.count);
+            if (c.count == 3 || c.count == 5) {
+              if (c.ofs < 0) return -7;
+              const uint8_t* np2 = m->buf_ptr(c.buf) + c.ofs;
+              size_t nl2 = (size_t)(c.end - c.ofs);
+              w.write_key(np2, nl2, utf16_len_of(np2, nl2));
+            }
+          } else {
+            return -7;
+          }
+          break;
+        }
+        default:
+          return -7;
+      }
+    }
+  }
+  // DS section (DSEncoderV2: delta clocks, len-1; groups from
+  // build_diff_prep)
+  auto& dg_client = prep.dg_client;
+  auto& dg_start = prep.dg_start;
+  auto& dg_len = prep.dg_len;
+  auto& d_clock = prep.d_clock;
+  auto& d_len = prep.d_len;
+  w.rest.varuint(dg_client.size());
+  for (size_t g = 0; g < dg_client.size(); g++) {
+    int64_t cur = 0;
+    w.rest.varuint((uint64_t)dg_client[g]);
+    w.rest.varuint((uint64_t)dg_len[g]);
+    for (int64_t i = dg_start[g]; i < dg_start[g] + dg_len[g]; i++) {
+      w.rest.varuint((uint64_t)(d_clock[(size_t)i] - cur));
+      cur = d_clock[(size_t)i];
+      if (d_len[(size_t)i] <= 0) return -4;
+      w.rest.varuint((uint64_t)(d_len[(size_t)i] - 1));
+      cur += d_len[(size_t)i];
+    }
+  }
+  *out_bytes = w.finish();
+  return (int64_t)out_bytes->size();
+}
+
 }  // namespace
 
 // the V1 wire writer (transcode.cpp, same shared object)
@@ -1563,6 +1978,7 @@ extern "C" int64_t ytpu_encode_v1(
 
 namespace {
 
+
 // full-native sync encode: rows beyond a remote state vector, written
 // straight from the mirror state (reference encodeStateAsUpdate,
 // encoding.js:490-526 + writeClientsStructs :94-116).  Returns bytes
@@ -1572,20 +1988,13 @@ int64_t mirror_encode_diff(Mirror* m, const int64_t* sv_clients,
                            const int64_t* sv_clocks, int64_t n_sv,
                            const int64_t* ds_ranges, int64_t n_ds_override,
                            int ds_override, uint8_t* out, uint64_t cap) {
-  size_t n_slots = m->client_of_slot.size();
-  std::vector<int64_t> remote(n_slots, 0);
-  for (int64_t i = 0; i < n_sv; i++) {
-    auto it = m->slot_of_client.find(sv_clients[i]);
-    if (it != m->slot_of_client.end())
-      remote[(size_t)it->second] = sv_clocks[i];
-  }
+  DiffPrep prep;
+  build_diff_prep(m, sv_clients, sv_clocks, n_sv, ds_ranges, n_ds_override,
+                  ds_override, &prep);
+  auto& remote = prep.remote;
   // slots in descending client order ("heavily improves the conflict
   // algorithm", encoding.js:112)
-  std::vector<size_t> slot_order(n_slots);
-  for (size_t s = 0; s < n_slots; s++) slot_order[s] = s;
-  std::sort(slot_order.begin(), slot_order.end(), [&](size_t a, size_t b) {
-    return m->client_of_slot[a] > m->client_of_slot[b];
-  });
+  auto& slot_order = prep.slot_order;
   // selected rows, flat in group order
   std::vector<int64_t> g_client, g_start, g_len;
   std::vector<int64_t> c_clock, c_len, c_ofs, c_oc, c_ok, c_rc, c_rk, c_ref;
@@ -1635,43 +2044,12 @@ int64_t mirror_encode_diff(Mirror* m, const int64_t* sv_clients,
       g_len.push_back((int64_t)(c_clock.size() - start));
     }
   }
-  // DS section
-  std::vector<int64_t> dg_client, dg_start, dg_len, d_clock, d_len;
-  auto push_union = [&](int64_t client,
-                        std::vector<std::array<int64_t, 2>>& ranges) {
-    std::sort(ranges.begin(), ranges.end());
-    size_t start = d_clock.size();
-    for (auto& [ck, ln] : ranges) {
-      if (!d_clock.empty() && d_clock.size() > start &&
-          ck <= d_clock.back() + d_len.back()) {
-        d_len.back() = std::max(d_len.back(), ck + ln - d_clock.back());
-      } else {
-        d_clock.push_back(ck);
-        d_len.push_back(ln);
-      }
-    }
-    if (d_clock.size() > start) {
-      dg_client.push_back(client);
-      dg_start.push_back((int64_t)start);
-      dg_len.push_back((int64_t)(d_clock.size() - start));
-    }
-  };
-  if (ds_override) {
-    // override ranges grouped by client in first-appearance order
-    std::vector<int64_t> order;
-    std::unordered_map<int64_t, std::vector<std::array<int64_t, 2>>> by;
-    for (int64_t i = 0; i < n_ds_override; i++) {
-      int64_t cl = ds_ranges[i * 3];
-      if (!by.count(cl)) order.push_back(cl);
-      by[cl].push_back({{ds_ranges[i * 3 + 1], ds_ranges[i * 3 + 2]}});
-    }
-    for (int64_t cl : order) push_union(cl, by[cl]);
-  } else {
-    for (int64_t slot : m->ds_slot_order) {
-      auto ranges = m->ds[slot];  // copy: union sorts
-      push_union(m->client_of_slot[(size_t)slot], ranges);
-    }
-  }
+  // DS section (built by build_diff_prep)
+  auto& dg_client = prep.dg_client;
+  auto& dg_start = prep.dg_start;
+  auto& dg_len = prep.dg_len;
+  auto& d_clock = prep.d_clock;
+  auto& d_len = prep.d_len;
   std::vector<const uint8_t*> bptrs;
   std::vector<uint64_t> blens;
   for (auto& [p, ln] : m->bufs) {
@@ -2022,6 +2400,22 @@ int64_t ymx_encode_diff(void* h, const int64_t* sv_clients,
                         int ds_override, uint8_t* out, uint64_t cap) {
   return mirror_encode_diff(static_cast<Mirror*>(h), sv_clients, sv_clocks,
                             n_sv, ds_ranges, n_ds, ds_override, out, cap);
+}
+
+// V2 twin of ymx_encode_diff (byte-identical to the Python
+// UpdateEncoderV2 output).  Same fallback contract: -7 -> Python writer.
+int64_t ymx_encode_diff_v2(void* h, const int64_t* sv_clients,
+                           const int64_t* sv_clocks, int64_t n_sv,
+                           const int64_t* ds_ranges, int64_t n_ds,
+                           int ds_override, uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> bytes;
+  int64_t rc = mirror_encode_diff_v2(static_cast<Mirror*>(h), sv_clients,
+                                     sv_clocks, n_sv, ds_ranges, n_ds,
+                                     ds_override, &bytes);
+  if (rc < 0) return rc;
+  if (bytes.size() > cap) return -2;
+  std::memcpy(out, bytes.data(), bytes.size());
+  return (int64_t)bytes.size();
 }
 
 int64_t ymx_compact(void* h, const int32_t* right_link,

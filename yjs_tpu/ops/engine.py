@@ -1199,15 +1199,15 @@ class BatchEngine:
                     enc_sv = e.to_bytes()
                 replies[j] = self.encode_state_as_update(i, enc_sv, v2=v2)
         # native mirrors answer straight from the C++ columns: one
-        # ymx_encode_diff call per request, no device round trip (the
+        # ymx_encode_diff(_v2) call per request, no device round trip (the
         # device diff kernel still serves Python-mirror engines and can be
         # forced with YTPU_SYNC_DEVICE=1)
-        if not v2 and not os.environ.get("YTPU_SYNC_DEVICE"):
+        if not os.environ.get("YTPU_SYNC_DEVICE"):
             rest = []
             for j, i, sv in dev:
                 m = self.mirrors[i]
                 enc = getattr(m, "encode_diff_update", None)
-                u = enc(sv) if enc is not None else None
+                u = enc(sv, v2=v2) if enc is not None else None
                 if u is None:
                     rest.append((j, i, sv))
                 else:

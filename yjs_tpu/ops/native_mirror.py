@@ -163,6 +163,21 @@ class NativePlan:
         return out
 
 
+def _empty_v2_update() -> bytes:
+    """The no-novelty V2 container (feature byte + nine empty streams +
+    0-group 0-DS rest) — the V2 analogue of the V1 b"\\x00\\x00"."""
+    from ..coding import UpdateEncoderV2
+    from ..lib0 import encoding as lib0enc
+
+    e = UpdateEncoderV2()
+    lib0enc.write_var_uint(e.rest_encoder, 0)
+    lib0enc.write_var_uint(e.rest_encoder, 0)
+    return e.to_bytes()
+
+
+_EMPTY_V2 = _empty_v2_update()
+
+
 class NativeMirror:
     """Drop-in DocMirror replacement backed by the native plan core."""
 
@@ -319,13 +334,17 @@ class NativeMirror:
     # -- native wire encodes -------------------------------------------------
 
     def encode_diff_update(
-        self, target_sv: dict[int, int] | None, ds_ranges=None
+        self, target_sv: dict[int, int] | None, ds_ranges=None,
+        v2: bool = False,
     ) -> bytes | None:
         """The doc's diff against ``target_sv`` encoded fully natively
         (reference encodeStateAsUpdate, encoding.js:490-526); ``ds_ranges``
-        overrides the DS section (the flush-novelty form).  Returns None
-        when the native writer cannot serve it (V2-framed payloads in the
-        selection) — callers fall back to the shadow's encode."""
+        overrides the DS section (the flush-novelty form); ``v2`` selects
+        the 9-stream columnar container.  Returns None when the native
+        writer cannot serve the selection — for V1 output that is V2-framed
+        embed/format/type payloads, for V2 output V1-framed ones, plus any
+        Python-realized (spilled) content — and callers fall back to the
+        shadow's encode."""
         lib, h = self._lib, self._h
         sv = target_sv or {}
         n_sv = len(sv)
@@ -342,33 +361,45 @@ class NativeMirror:
                 else np.zeros(3, np.int64)
             )
             override = 1
-        out = np.empty(int(lib.ymx_encode_bound(h)), np.uint8)
-        rc = int(
-            lib.ymx_encode_diff(
-                h, _p64(svc), _p64(svk), n_sv, _p64(ds), n_ds,
-                override, out.ctypes.data_as(_u8p),
-                ctypes.c_uint64(len(out)),
+        fn = lib.ymx_encode_diff_v2 if v2 else lib.ymx_encode_diff
+        cap = int(lib.ymx_encode_bound(h))
+        for _attempt in range(3):
+            out = np.empty(cap, np.uint8)
+            rc = int(
+                fn(
+                    h, _p64(svc), _p64(svk), n_sv, _p64(ds), n_ds,
+                    override, out.ctypes.data_as(_u8p),
+                    ctypes.c_uint64(len(out)),
+                )
             )
-        )
-        if rc < 0:
-            return None
-        return out[:rc].tobytes()
+            if rc == -2:  # writer overflow: the bound is V1-derived and a
+                # V2 stream can exceed it — grow and retry, never silently
+                # degrade to the Python writer
+                cap *= 4
+                continue
+            if rc < 0:
+                return None
+            return out[:rc].tobytes()
+        return None
 
     def encode_state_as_update(self, target_sv=None, v2: bool = False) -> bytes:
-        if not v2:
-            u = self.encode_diff_update(target_sv)
-            if u is not None:
-                return u
+        u = self.encode_diff_update(target_sv, v2=v2)
+        if u is not None:
+            return u
         self._sync()
         return DocMirror.encode_state_as_update(self._py, target_sv, v2=v2)
 
     def encode_step_update(self, pre_sv, plan, v2: bool = False) -> bytes | None:
-        if not v2:
-            u = self.encode_diff_update(pre_sv, ds_ranges=plan.applied_ds)
-            if u is not None:
-                # header-only update (0 struct groups, 0 DS clients) means
-                # the flush produced no novelty — match the None contract
-                return None if u == b"\x00\x00" else u
+        u = self.encode_diff_update(pre_sv, ds_ranges=plan.applied_ds, v2=v2)
+        if u is not None:
+            # a no-novelty update means the flush changed nothing visible —
+            # match the None contract (V1: 0 groups + 0 DS clients; the V2
+            # container's empty form is longer, compare against it)
+            if not v2 and u == b"\x00\x00":
+                return None
+            if v2 and u == _EMPTY_V2:
+                return None
+            return u
         self._sync()
         return DocMirror.encode_step_update(self._py, pre_sv, plan, v2=v2)
 
