@@ -88,6 +88,28 @@ def test_engine_batched_svs_use_sharded_kernel(mesh8):
     assert eng._sharded_sv
 
 
+def test_sharded_levels_kernel_path(mesh8):
+    """YTPU_KERNEL=levels keeps the shard_map YATA step working on the
+    mesh (the on-device integration form; default is the sharded bulk
+    apply)."""
+    import os
+
+    os.environ["YTPU_KERNEL"] = "levels"
+    try:
+        n = 8
+        docs = build_docs(n)
+        eng = BatchEngine(n, mesh=mesh8)
+        for i, d in enumerate(docs):
+            eng.queue_update(i, Y.encode_state_as_update(d))
+        eng.flush()
+        assert eng.last_metrics is not None
+        assert eng.last_metrics["integrated"] > 0
+        for i, d in enumerate(docs):
+            assert eng.text(i) == d.get_text("text").to_string()
+    finally:
+        os.environ.pop("YTPU_KERNEL", None)
+
+
 def test_meshed_engine_arrays_stay_on_mesh(mesh8):
     """Every device array of a meshed engine lives on the mesh's devices —
     an unpinned transfer would land on the default backend/device instead

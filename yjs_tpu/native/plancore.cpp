@@ -1684,7 +1684,9 @@ struct StringW {  // lib0 StringEncoder: one UTF-8 arena + u16-length runs
   }
   // cut `off` UTF-16 units off the front (the partial-first-struct rule),
   // with the surrogate-pair U+FFFD repair of write_cut_string
-  void write_cut(const uint8_t* s, uint64_t blen, int64_t off) {
+  // false on a truncated trailing multi-byte sequence (the skip loop
+  // would overshoot — same guard as desc_split's surrogate branch)
+  bool write_cut(const uint8_t* s, uint64_t blen, int64_t off) {
     uint64_t i = 0;
     bool mid = false;
     int64_t skipped = 0;
@@ -1699,12 +1701,14 @@ struct StringW {  // lib0 StringEncoder: one UTF-8 arena + u16-length runs
         if (skipped > off) mid = true;
       }
     }
+    if (i > blen) return false;  // malformed UTF-8 tail
     if (mid) {  // the cut consumed a pair: emit the U+FFFD low half
       static const uint8_t rep[3] = {0xEF, 0xBF, 0xBD};
       arena.insert(arena.end(), rep, rep + 3);
     }
     arena.insert(arena.end(), s + i, s + blen);
     lens.write(total - off);
+    return true;
   }
   void emit(VecW* out) {
     // StringEncoder.to_bytes = var_string(arena) + RAW lens bytes, the
@@ -1872,8 +1876,9 @@ int64_t mirror_encode_diff_v2(Mirror* m, const int64_t* sv_clients,
           w.len.write(m->r_len[r] - ofs);
           break;
         case kKindUtf8:
-          w.str.write_cut(m->buf_ptr(c.buf) + c.ofs,
-                          (uint64_t)(c.end - c.ofs), ofs);
+          if (!w.str.write_cut(m->buf_ptr(c.buf) + c.ofs,
+                               (uint64_t)(c.end - c.ofs), ofs))
+            return -4;
           break;
         case kKindAnys: {  // write_len + element any bytes into rest
           w.len.write(c.count - ofs);
@@ -2413,7 +2418,8 @@ int64_t ymx_encode_diff_v2(void* h, const int64_t* sv_clients,
                                      sv_clocks, n_sv, ds_ranges, n_ds,
                                      ds_override, &bytes);
   if (rc < 0) return rc;
-  if (bytes.size() > cap) return -2;
+  if (bytes.size() > cap)  // needed size, negative-encoded (caller
+    return -(int64_t)bytes.size();  // retries once with an exact buffer)
   std::memcpy(out, bytes.data(), bytes.size());
   return (int64_t)bytes.size();
 }

@@ -168,6 +168,8 @@ class BatchEngine:
         # cached sharded state-vector callables keyed by n_slots (jit's
         # cache is per function identity — rebuilding retraces every call)
         self._sharded_sv: dict[int, object] = {}
+        # cached sharded bulk-apply callables keyed by lane bucket shape
+        self._sharded_apply: dict[tuple, object] = {}
         # explicit placement: a meshed engine pins EVERY host->device
         # transfer to the mesh's devices so it can never touch the default
         # backend (the mesh may be a virtual CPU mesh while the default
@@ -537,13 +539,14 @@ class BatchEngine:
         demoted_now = 0
         emitting = bool(self._update_listeners)
         observing = self._event_listeners
-        # kernel selection: "apply" (default) ships the planner's final
-        # link values in one conflict-free scatter; "levels"/"seq" run
-        # YATA on device (the sharded step uses the levels form)
+        # kernel selection: "apply" (default, meshed or not) ships the
+        # planner's final link values in one conflict-free scatter;
+        # "levels"/"seq" run YATA on device (the sharded levels step
+        # serves YTPU_KERNEL=levels on a mesh)
         mode = os.environ.get("YTPU_KERNEL")
         if not mode:
-            mode = "levels" if self._sharded_step is not None else "apply"
-        want_levels = mode != "apply" or self._sharded_step is not None
+            mode = "apply"
+        want_levels = mode != "apply"
         with _phase("plan"):
             for i, m in enumerate(self.mirrors):
                 if i in self.fallback:
@@ -582,7 +585,7 @@ class BatchEngine:
             metrics["t_total_s"] = time.perf_counter() - t_start
             self.last_flush_metrics = metrics
             return
-        if mode == "apply" and self._sharded_step is None:
+        if mode == "apply":
             self._flush_apply(plans, pre_svs, emitting, metrics, t_start, t_plan)
             return
         with _phase("pack"):
@@ -759,60 +762,84 @@ class BatchEngine:
             self._ensure_capacity(max_rows, max_segs)
             b = self.n_docs
             oob_r = np.int32(self._cap + 1)
+            # one binning "shard" on a single device; the mesh path bins
+            # per device shard so each scatters its own lanes locally
+            n_shards = 1 if self.mesh is None else self.mesh.shape[
+                self.mesh.axis_names[0]
+            ]
+            b_loc = b // n_shards
             # per-doc counts ride in the lanes header; doc ids and dense
             # row indices are derived ON DEVICE (kernels.apply_plan2), so
             # the transfer carries the minimum: full-table ("dense") link
             # loads ship values only
-            counts = np.zeros((4, b), np.int32)
-            dense, sp_r, sp_v, hd_s, hd_v, dl_r = [], [], [], [], [], []
+            counts = np.zeros((n_shards, 4, b_loc), np.int32)
+            dense = [[] for _ in range(n_shards)]
+            sp_r = [[] for _ in range(n_shards)]
+            sp_v = [[] for _ in range(n_shards)]
+            hd_s = [[] for _ in range(n_shards)]
+            hd_v = [[] for _ in range(n_shards)]
+            dl_r = [[] for _ in range(n_shards)]
             for i, p in plans.items():
+                s, li = divmod(i, b_loc)
                 k = len(p.link_rows)
                 rows = np.asarray(p.link_rows, np.int32)
                 vals = np.asarray(p.link_vals, np.int32)
                 if k and k == p.n_rows and rows[-1] == k - 1:
-                    counts[0, i] = k
-                    dense.append(vals)
+                    counts[s, 0, li] = k
+                    dense[s].append(vals)
                 elif k:
-                    counts[1, i] = k
-                    sp_r.append(rows)
-                    sp_v.append(vals)
+                    counts[s, 1, li] = k
+                    sp_r[s].append(rows)
+                    sp_v[s].append(vals)
                 hn = len(p.head_segs)
                 if hn:
-                    counts[2, i] = hn
-                    hd_s.append(np.asarray(p.head_segs, np.int32))
-                    hd_v.append(np.asarray(p.head_vals, np.int32))
+                    counts[s, 2, li] = hn
+                    hd_s[s].append(np.asarray(p.head_segs, np.int32))
+                    hd_v[s].append(np.asarray(p.head_vals, np.int32))
                 dn = len(p.delete_rows)
                 if dn:
-                    counts[3, i] = dn
-                    dl_r.append(np.asarray(p.delete_rows, np.int32))
+                    counts[s, 3, li] = dn
+                    dl_r[s].append(np.asarray(p.delete_rows, np.int32))
 
-            def sect(parts, pad_val, minimum=64):
+            def widths(parts_by_shard, minimum):
+                return _bucket(
+                    max(
+                        (sum(len(a) for a in parts) for parts in parts_by_shard),
+                        default=0,
+                    ),
+                    minimum,
+                )
+
+            k_dn = widths(dense, 64)
+            k_sp = widths(sp_r, 64)
+            k_h = widths(hd_s, 8)
+            k_d = widths(dl_r, 64)
+            oob_s = np.int32(self._seg_cap + 1)
+
+            def fill(out, parts, pad_val):
                 flat = (
                     np.concatenate(parts) if parts else np.zeros(0, np.int32)
                 )
-                total = len(flat)
-                k = _bucket(total, minimum)
-                if k > total:
-                    flat = np.concatenate(
-                        [flat, np.full(k - total, pad_val, np.int32)]
-                    )
-                return flat, k, total
+                out[: len(flat)] = flat
+                out[len(flat):] = pad_val
+                return len(flat)
 
-            dense_f, k_dn, n_dense = sect(dense, NULL)
-            spr_f, k_sp, n_sparse = sect(sp_r, oob_r)
-            spv_f = np.concatenate(sp_v) if sp_v else np.zeros(0, np.int32)
-            spv_f = np.concatenate(
-                [spv_f, np.full(k_sp - len(spv_f), NULL, np.int32)]
-            ) if k_sp > len(spv_f) else spv_f
-            hds_f, k_h, n_heads = sect(hd_s, np.int32(self._seg_cap + 1), 8)
-            hdv_f = np.concatenate(hd_v) if hd_v else np.zeros(0, np.int32)
-            hdv_f = np.concatenate(
-                [hdv_f, np.full(k_h - len(hdv_f), NULL, np.int32)]
-            ) if k_h > len(hdv_f) else hdv_f
-            dlr_f, k_d, n_dels = sect(dl_r, oob_r)
-            lanes = np.concatenate(
-                [counts.ravel(), dense_f, spr_f, spv_f, hds_f, hdv_f, dlr_f]
-            )
+            lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
+            lanes = np.empty((n_shards, lane_w), np.int32)
+            n_dense = n_sparse = n_heads = n_dels = 0
+            for s in range(n_shards):
+                o = 0
+                lanes[s, : 4 * b_loc] = counts[s].ravel()
+                o = 4 * b_loc
+                n_dense += fill(lanes[s, o : o + k_dn], dense[s], NULL)
+                o += k_dn
+                n_sparse += fill(lanes[s, o : o + k_sp], sp_r[s], oob_r)
+                fill(lanes[s, o + k_sp : o + 2 * k_sp], sp_v[s], NULL)
+                o += 2 * k_sp
+                n_heads += fill(lanes[s, o : o + k_h], hd_s[s], oob_s)
+                fill(lanes[s, o + k_h : o + 2 * k_h], hd_v[s], NULL)
+                o += 2 * k_h
+                n_dels += fill(lanes[s, o : o + k_d], dl_r[s], oob_r)
             # the apply path never reads the device statics; mark touched
             # docs for full (re-)upload if a levels/seq flush ever runs
             for i in plans:
@@ -821,9 +848,22 @@ class BatchEngine:
         with _phase("dispatch"):
             self._metrics_dev = None
             dyn = (self._right, self._deleted, self._starts)
-            self._right, self._deleted, self._starts = kernels.apply_plan2(
-                dyn, self._put_r(lanes), k_dn, k_sp, k_h, k_d
-            )
+            if self.mesh is not None:
+                key = (k_dn, k_sp, k_h, k_d)
+                fn = self._sharded_apply.get(key)
+                if fn is None:
+                    from ..parallel.mesh import sharded_apply_plan
+
+                    fn = sharded_apply_plan(
+                        self.mesh, self.mesh.axis_names[0], *key
+                    )
+                    self._sharded_apply[key] = fn
+                dyn, self._metrics_dev = fn(dyn, self._put_b(lanes))
+            else:
+                dyn = kernels.apply_plan2(
+                    dyn, self._put_r(lanes[0]), k_dn, k_sp, k_h, k_d
+                )
+            self._right, self._deleted, self._starts = dyn
         t_dispatch = time.perf_counter()
         with _phase("emit"):
             self._emit_phase(plans, pre_svs, emitting)

@@ -394,6 +394,12 @@ def apply_plan2(dyn, lanes, k_dn, k_sp, k_h, k_d):
       [s|v]*k_h         segment-head writes
       [r]*k_d           delete marks
     """
+    return apply_lanes(dyn, lanes, k_dn, k_sp, k_h, k_d)
+
+
+def apply_lanes(dyn, lanes, k_dn, k_sp, k_h, k_d):
+    """The apply_plan2 body as a plain traceable function — reused by the
+    sharded mesh step (each shard applies its own lanes block locally)."""
     right_link, deleted, starts = dyn
     b = right_link.shape[0]
     n1 = right_link.shape[1]

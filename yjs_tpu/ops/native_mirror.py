@@ -363,7 +363,7 @@ class NativeMirror:
             override = 1
         fn = lib.ymx_encode_diff_v2 if v2 else lib.ymx_encode_diff
         cap = int(lib.ymx_encode_bound(h))
-        for _attempt in range(3):
+        for _attempt in range(2):
             out = np.empty(cap, np.uint8)
             rc = int(
                 fn(
@@ -372,10 +372,10 @@ class NativeMirror:
                     ctypes.c_uint64(len(out)),
                 )
             )
-            if rc == -2:  # writer overflow: the bound is V1-derived and a
-                # V2 stream can exceed it — grow and retry, never silently
-                # degrade to the Python writer
-                cap *= 4
+            if rc < -100:  # overflow: the V2 writer reports the exact
+                # size needed (the bound is V1-derived) — retry once with
+                # an exact buffer rather than degrading to Python
+                cap = -rc
                 continue
             if rc < 0:
                 return None

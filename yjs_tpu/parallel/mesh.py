@@ -92,6 +92,38 @@ def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
     return jax.jit(sharded, donate_argnums=(1,))
 
 
+def sharded_apply_plan(mesh: Mesh, axis: str, k_dn: int, k_sp: int,
+                       k_h: int, k_d: int):
+    """The bulk-apply flush sharded over the doc axis: each shard scatters
+    its own lanes block into its dyn shard locally (docs are independent —
+    no cross-shard communication except the psum'd progress counters).
+
+    lanes: [n_shards, 4*B_local + k_dn + 2*k_sp + 2*k_h + k_d] i32,
+    sharded on axis 0; dyn arrays sharded on their doc axis.
+    """
+    spec = P(axis)
+
+    def local_apply(dyn, lanes):
+        lanes1 = lanes[0]
+        b_loc = dyn[0].shape[0]
+        out = kernels.apply_lanes(dyn, lanes1, k_dn, k_sp, k_h, k_d)
+        integrated = jnp.sum(lanes1[: 2 * b_loc])  # dense + sparse counts
+        deleted = jnp.sum(lanes1[3 * b_loc : 4 * b_loc])
+        metrics = {
+            "integrated": lax.psum(integrated, axis),
+            "deleted": lax.psum(deleted, axis),
+        }
+        return out, metrics
+
+    sharded = shard_map(
+        local_apply,
+        mesh=mesh,
+        in_specs=((spec, spec, spec), spec),
+        out_specs=((spec, spec, spec), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def sharded_state_vectors(mesh: Mesh, n_slots: int, axis: str = "docs", row_axis: str | None = None):
     """State vectors over a sharded doc batch; with a 2-D mesh the item-table
     axis is also sharded and reduced with pmax over ICI (the segment-max of
