@@ -24,11 +24,13 @@ from __future__ import annotations
 from .columns import DocMirror, UnsupportedUpdate
 
 
-def _loaded_mirror(updates: list[bytes], v2: bool) -> DocMirror:
-    m = DocMirror("")
+def _loaded_mirror(updates: list[bytes], v2: bool):
+    from .native_mirror import NativeMirror, native_plan_available
+
+    m = NativeMirror("") if native_plan_available() else DocMirror("")
     for u in updates:
         m.ingest(u, v2)
-    m.prepare_step()
+    m.prepare_step(want_levels=False)
     return m
 
 
