@@ -298,14 +298,20 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
     warm.flush()
     np.asarray(warm._right[:, 0])
 
-    eng = BatchEngine(n_docs)
-    t0 = time.perf_counter()
-    for i, u in enumerate(updates):
-        eng.queue_update(i, u)
-    eng.flush()
-    # readback barrier: force device completion
-    np.asarray(eng._right[:, 0])
-    t_e2e = time.perf_counter() - t0
+    # median of 3 timed runs: host-core and tunnel contention swing
+    # single runs 2-4x (BASELINE.md), and the server shape is steady-state
+    runs = []
+    for _ in range(3):
+        eng = BatchEngine(n_docs)
+        t0 = time.perf_counter()
+        for i, u in enumerate(updates):
+            eng.queue_update(i, u)
+        eng.flush()
+        # readback barrier: force device completion
+        np.asarray(eng._right[:, 0])
+        runs.append((time.perf_counter() - t0, eng))
+    runs.sort(key=lambda r: r[0])
+    t_e2e, eng = runs[1]  # metrics below come from the SAME median run
 
     # convergence spot-check on 3 docs (distinct traces -> meaningful)
     import yjs_tpu as Y

@@ -338,6 +338,18 @@ struct Mirror {
     }
   }
 
+  void reserve_rows(size_t extra) {
+    size_t want = r_slot.size() + extra;
+    if (r_slot.capacity() >= want) return;
+    r_slot.reserve(want); r_clock.reserve(want); r_len.reserve(want);
+    r_oslot.reserve(want); r_oclock.reserve(want);
+    r_rslot.reserve(want); r_rclock.reserve(want);
+    r_ref.reserve(want); r_seg.reserve(want);
+    r_is_gc.reserve(want); r_countable.reserve(want);
+    r_c.reserve(want); r_host_deleted.reserve(want);
+    r_lww_deleted.reserve(want); list_next.reserve(want);
+  }
+
   int64_t add_row(int64_t slot_, int64_t clock, int64_t length,
                   int64_t oc, int64_t ok_, int64_t rc, int64_t rk,
                   bool is_gc, const ContentDesc& c, int64_t ref,
@@ -877,14 +889,17 @@ struct Mirror {
     lap("scan");
     pending_ds.clear();
 
-    // merge incoming into the pending queues, clock-sorted (stable)
+    // merge incoming into the pending queues, clock-sorted (stable).
+    // The common case — one ordered update per client, empty queue — is
+    // already sorted; skip the fat-struct stable_sort then.
     for (auto& [client, rs] : incoming) {
       auto& q = pending[client];
       q.insert(q.end(), rs.begin(), rs.end());
-      std::stable_sort(q.begin(), q.end(),
-                       [](const PendRef& a, const PendRef& b) {
-                         return a.clock < b.clock;
-                       });
+      auto by_clock = [](const PendRef& a, const PendRef& b) {
+        return a.clock < b.clock;
+      };
+      if (!std::is_sorted(q.begin(), q.end(), by_clock))
+        std::stable_sort(q.begin(), q.end(), by_clock);
     }
 
     lap("merge");
@@ -1060,6 +1075,7 @@ struct Mirror {
 
     lap("pre-split");
     // row assignment + pointer resolution
+    reserve_rows(frag_sched.size());
     std::vector<int64_t> touched_map_segs;  // ascending on use (set below)
     std::set<int64_t> touched_set;
     for (auto& ref : frag_sched) {
