@@ -20,6 +20,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # are not initialized yet at conftest time).  YTPU_TEST_PLATFORM overrides.
 _platform = os.environ.get("YTPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
+# engine list/text/map/delta exports read back DEVICE state in tests so
+# the oracle comparisons validate the kernels' output (typed events are
+# host-plan-derived by design; production defaults to the host list walk
+# and test_host_export_matches_device pins the two equal)
+os.environ.setdefault("YTPU_EXPORT_DEVICE", "1")
 import sys
 
 if "jax" in sys.modules:

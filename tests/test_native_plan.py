@@ -383,3 +383,26 @@ def test_native_v2_encode_byte_parity(rng):
                     d.get_text("text").to_string()
                     == a.get_text("text").to_string()
                 )
+
+
+def test_host_export_matches_device(rng):
+    """The default (host list walk) export equals the device-rank export
+    on fuzzed traffic — the per-doc device dispatch in exports is gone
+    from the product path but stays the verification path."""
+    from yjs_tpu.ops import BatchEngine
+
+    updates, a, _ = two_client_session(rng, 50, rich=True)
+    eng = BatchEngine(1)
+    for j, u in enumerate(updates):
+        eng.queue_update(0, u)
+        if j % 6 == 5:
+            eng.flush()
+    eng.flush()
+    eng.export_from_device = False
+    host = (eng.rows_in_order(0), eng.text(0), eng.to_json(0, "list"),
+            eng.map_json(0, "meta"), eng.to_delta(0))
+    eng.export_from_device = True
+    dev = (eng.rows_in_order(0), eng.text(0), eng.to_json(0, "list"),
+           eng.map_json(0, "meta"), eng.to_delta(0))
+    assert host == dev
+    assert host[1] == a.get_text("text").to_string()

@@ -152,6 +152,11 @@ class BatchEngine:
         # "device" records demotions as in auto (state stays consistent and
         # no data is lost) but the Provider raises while any exist
         self.policy = policy
+        # export source: host list walk (default — zero device round trips)
+        # or device rank kernel (the verification path; the test suite sets
+        # YTPU_EXPORT_DEVICE=1 so every oracle comparison validates the
+        # DEVICE state, and a dedicated test pins host==device)
+        self.export_from_device = os.environ.get("YTPU_EXPORT_DEVICE") == "1"
         # per-doc row count at the last compaction (growth trigger)
         self._rows_at_compact = [0] * n_docs
         # per-doc stats of the most recent flush's compactions
@@ -915,10 +920,29 @@ class BatchEngine:
         return self.mirrors[doc].state_vector()
 
     def _order(self, doc: int, seg: int) -> tuple[np.ndarray, np.ndarray]:
-        """Segment-order row ids + deleted flags for one doc's segment."""
+        """Segment-order row ids + deleted flags for one doc's segment.
+
+        Host path (default): walk the planner's linked list — no device
+        round trip (the r2 "per-doc dispatches in exports" weakness).
+        Device path (export_from_device): rank the doc's resident right
+        links with the pointer-doubling kernel and read back — exports
+        then PROVE the device state, which is how the test suite runs.
+        """
+        m = self.mirrors[doc]
+        if not self.export_from_device:
+            rows_l: list = []
+            dele_l: list = []
+            host_deleted = m._host_deleted_rows
+            nxt = m.list_next
+            r = m.head_of_seg[seg] if seg < len(m.head_of_seg) else NULL
+            while r != NULL:
+                r = int(r)
+                rows_l.append(r)
+                dele_l.append(r in host_deleted)
+                r = nxt[r]
+            return np.asarray(rows_l, np.int64), np.asarray(dele_l, bool)
         if self._right is None:
             return np.zeros(0, np.int64), np.zeros(0, bool)
-        m = self.mirrors[doc]
         valid_host = np.zeros(self._right.shape[1], bool)
         n = m.n_rows
         if n:
@@ -1004,25 +1028,22 @@ class BatchEngine:
                 ops.append(op)
                 parts.clear()
 
-        deleted = m._host_deleted_rows
-        nxt = m.list_next
-        r = m.head_of_seg[seg]
-        while r != NULL:
-            r = int(r)
-            if r not in deleted:
-                c = m.realized_content(r)
-                if isinstance(c, ContentString):
-                    parts.append(c.str)
-                elif isinstance(c, ContentEmbed):
-                    pack_str()
-                    op = {"insert": c.embed}
-                    if cur:
-                        op["attributes"] = dict(cur)
-                    ops.append(op)
-                elif isinstance(c, ContentFormat):
-                    pack_str()
-                    update_current_attributes(cur, c)
-            r = nxt[r]
+        rows, dels = self._order(doc, seg)
+        for r, dl in zip(rows, dels):
+            if dl:
+                continue
+            c = m.realized_content(int(r))
+            if isinstance(c, ContentString):
+                parts.append(c.str)
+            elif isinstance(c, ContentEmbed):
+                pack_str()
+                op = {"insert": c.embed}
+                if cur:
+                    op["attributes"] = dict(cur)
+                ops.append(op)
+            elif isinstance(c, ContentFormat):
+                pack_str()
+                update_current_attributes(cur, c)
         pack_str()
         return ops
 
@@ -1053,13 +1074,13 @@ class BatchEngine:
             ]
         out = {}
         for sub, seg in segs:
-            chain = m.map_chain.get(seg)
-            if not chain:
+            # the map-key chain is a device segment like any list: its
+            # visible value is the last undeleted entry of the chain in
+            # list order (LWW keeps only the final tail undeleted)
+            rows, dels = self._order(doc, seg)
+            if not len(rows) or dels[-1]:
                 continue
-            tail = chain[-1]
-            if tail in m._lww_deleted:
-                continue
-            out[sub] = self._value_of_row(doc, tail)
+            out[sub] = self._value_of_row(doc, int(rows[-1]))
         return out
 
     def _value_of_row(self, doc: int, row: int):
