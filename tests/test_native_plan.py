@@ -406,3 +406,91 @@ def test_host_export_matches_device(rng):
            eng.map_json(0, "meta"), eng.to_delta(0))
     assert host == dev
     assert host[1] == a.get_text("text").to_string()
+
+
+def test_broadcast_kernels_agree(rng):
+    """The broadcast YATA kernel (batch_step_levels_shared: one schedule,
+    vmap in_axes=None) and the broadcast bulk apply (apply_plan_shared:
+    host-resolved final links) produce identical device state — the
+    kernel-level form of the apply/levels/seq engine cross-check, on the
+    B4-replay shape."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from yjs_tpu.ops import kernels
+    from yjs_tpu.ops.columns import NULL, DocMirror
+
+    updates, a, _ = two_client_session(rng, 40)
+    mirror = DocMirror("text")
+    for u in updates:
+        mirror.ingest(u)
+    plan = mirror.prepare_step(want_levels=True)
+    n = mirror.n_rows
+    n_docs = 4
+    w_pad = max((plan.max_width, 1))
+    cap = max(64, n + 2 * w_pad)
+    seg_cap = max(8, mirror.n_segs)
+    cols = mirror.static_columns()
+
+    def pad_col(key, fill, dtype):
+        arr = np.full((cap + 1,), fill, dtype)
+        arr[:n] = cols[key]
+        return arr
+
+    statics = {
+        "client_key": jnp.asarray(pad_col("client_key", 0, np.uint32)),
+        "origin_slot": jnp.asarray(pad_col("origin_slot", NULL, np.int32)),
+        "origin_clock": jnp.asarray(pad_col("origin_clock", 0, np.int32)),
+        "right_slot": jnp.asarray(pad_col("right_slot", NULL, np.int32)),
+        "right_clock": jnp.asarray(pad_col("right_clock", 0, np.int32)),
+        "origin_row": jnp.asarray(pad_col("origin_row", NULL, np.int32)),
+    }
+    packed = plan.packed_levels()
+    lv = np.full((max(1, len(packed)), w_pad, 8), NULL, np.int32)
+    for j, entries in enumerate(packed):
+        if entries:
+            lv[j, : len(entries)] = entries
+    splits = np.full((max(1, len(plan.splits)), 2), NULL, np.int32)
+    if plan.splits:
+        splits[: len(plan.splits)] = np.asarray(plan.splits, np.int32)
+    dels = np.full((max(1, len(plan.delete_rows)),), NULL, np.int32)
+    if plan.delete_rows:
+        dels[: len(plan.delete_rows)] = np.asarray(plan.delete_rows, np.int32)
+
+    def fresh():
+        return (
+            jnp.full((n_docs, cap + 1), NULL, jnp.int32),
+            jnp.zeros((n_docs, cap + 1), bool),
+            jnp.full((n_docs, seg_cap + 1), NULL, jnp.int32),
+        )
+
+    out_yata = kernels.batch_step_levels_shared(
+        statics, fresh(), jnp.asarray(splits), jnp.asarray(lv),
+        jnp.asarray(dels), jnp.full((n_docs,), n, jnp.int32),
+    )
+
+    def pad_lanes(idx, vals, minimum, oob):
+        k = len(idx)
+        padded = max(minimum, 1 << max(0, (k - 1).bit_length()))
+        i = np.full(padded, oob, np.int32)
+        i[:k] = np.asarray(idx, np.int32)
+        if vals is None:
+            return i
+        v = np.full(padded, NULL, np.int32)
+        v[:k] = np.asarray(vals, np.int32)
+        return i, v
+
+    rows_p, vals_p = pad_lanes(plan.link_rows, plan.link_vals, 64, cap + 1)
+    segs_p, hvals_p = pad_lanes(plan.head_segs, plan.head_vals, 8, seg_cap + 1)
+    dels_p = pad_lanes(plan.delete_rows, None, 64, cap + 1)
+    lanes = jnp.asarray(np.concatenate([rows_p, vals_p, segs_p, hvals_p, dels_p]))
+    out_apply = kernels.apply_plan_shared(
+        fresh(), lanes, len(rows_p), len(segs_p), len(dels_p)
+    )
+    for name, x, y in zip(("right", "deleted", "starts"), out_yata, out_apply):
+        xa, ya = np.asarray(x), np.asarray(y)
+        if name != "starts":
+            xa, ya = xa[:, :n], ya[:, :n]
+        else:
+            xa, ya = xa[:, : mirror.n_segs], ya[:, : mirror.n_segs]
+        assert (xa == ya).all(), name
