@@ -218,3 +218,63 @@ def test_engine_to_delta_matches_cpu(rng):
     eng.flush()
     assert eng.to_delta(0) == cpu.get_text("text").to_delta()
     assert eng.to_delta(0)  # non-trivial traffic produced ops
+
+
+def test_engine_xml_string_matches_cpu(rng):
+    """Engine-served XML serialization vs the CPU doc (reference
+    YXmlFragment/YXmlElement/YXmlText toString)."""
+    a = Y.Doc(gc=False); a.client_id = 41
+    b = Y.Doc(gc=False); b.client_id = 42
+    updates = []
+    tags = ["div", "p", "span"]
+    for _ in range(60):
+        for d in (a, b):
+            sv = Y.encode_state_vector(d)
+            frag = d.get_xml_fragment("xml")
+            op = rng.random()
+            if op < 0.35 or len(frag) == 0:
+                el = Y.YXmlElement(rng.choice(tags))
+                frag.insert(rng.randint(0, len(frag)), [el])
+            elif op < 0.55:
+                el = frag.get(rng.randrange(len(frag)))
+                if isinstance(el, Y.YXmlElement):
+                    el.set_attribute(rng.choice("ab"), str(rng.randint(0, 9)))
+                    if rng.random() < 0.4:
+                        child = Y.YXmlText()
+                        el.insert(0, [child])
+            elif op < 0.7:
+                el = frag.get(rng.randrange(len(frag)))
+                if isinstance(el, Y.YXmlElement) and len(el) > 0:
+                    sub = el.get(0)
+                    if isinstance(sub, Y.YXmlText):
+                        sub.insert(0, rng.choice(["hi ", "yo "]))
+                        if rng.random() < 0.5 and len(sub) > 1:
+                            sub.format(0, 2, {"b": {"w": "1"}})
+            elif op < 0.85:
+                pos = rng.randrange(len(frag))
+                frag.delete(pos, 1)
+            else:
+                t = Y.YXmlText()
+                frag.insert(rng.randint(0, len(frag)), [t])
+            updates.append(Y.encode_state_as_update(d, sv))
+        if rng.random() < 0.5:
+            ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+            ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+            Y.apply_update(b, ua)
+            Y.apply_update(a, ub)
+    ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+    Y.apply_update(b, ua)
+    updates.append(ua)
+
+    cpu = Y.Doc(gc=False)
+    eng = BatchEngine(1, root_name="xml")
+    for j, u in enumerate(updates):
+        Y.apply_update(cpu, u)
+        eng.queue_update(0, u)
+        if j % 9 == 8:
+            eng.flush()
+            assert eng.xml_string(0) == cpu.get_xml_fragment("xml").to_string()
+    eng.flush()
+    expect = cpu.get_xml_fragment("xml").to_string()
+    assert eng.xml_string(0) == expect
+    assert expect  # non-trivial traffic

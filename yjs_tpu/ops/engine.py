@@ -1009,13 +1009,17 @@ class BatchEngine:
         fb = self.fallback.get(doc)
         if fb is not None:
             return fb.get_text(name).to_delta()
-        from ..core import ContentEmbed, ContentFormat, ContentString
-        from ..types.ytext import update_current_attributes
-
         m = self.mirrors[doc]
         seg = m.segments.get((name, None, NULL))
         if seg is None:
             return []
+        return self._delta_of_seg(doc, seg)
+
+    def _delta_of_seg(self, doc: int, seg: int) -> list:
+        from ..core import ContentEmbed, ContentFormat, ContentString
+        from ..types.ytext import update_current_attributes
+
+        m = self.mirrors[doc]
         ops: list = []
         cur: dict = {}
         parts: list[str] = []
@@ -1046,6 +1050,94 @@ class BatchEngine:
                 update_current_attributes(cur, c)
         pack_str()
         return ops
+
+    def xml_string(self, doc: int, name: str | None = None) -> str:
+        """Serialize a root XML fragment from the mirror (reference
+        YXmlFragment/YXmlElement/YXmlText toString — sorted attributes,
+        nested formatting tags), no CPU-doc replay."""
+        name = name or self.root_name
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            from ..types.yxml import YXmlHook
+
+            def render(t):
+                # YXmlHook inherits YMap and has no serialization in the
+                # reference; emit the same stable "" as the mirror path
+                if isinstance(t, YXmlHook):
+                    return ""
+                return t.to_string()
+
+            frag = fb.get_xml_fragment(name)
+            return "".join(render(t) for t in frag.to_array())
+        seg = self.mirrors[doc].segments.get((name, None, NULL))
+        if seg is None:
+            return ""
+        return self._xml_children(doc, seg)
+
+    def _xml_children(self, doc: int, seg: int) -> str:
+        m = self.mirrors[doc]
+        rows, dels = self._order(doc, seg)
+        parts: list[str] = []
+        for r, dl in zip(rows, dels):
+            r = int(r)
+            if dl or not m.row_countable[r]:
+                continue
+            c = m.realized_content(r)
+            if getattr(c, "REF", None) == 7:
+                parts.append(self._xml_node(doc, r, c))
+            else:
+                parts.extend(str(v) for v in c.get_content())
+        return "".join(parts)
+
+    def _xml_node(self, doc: int, row: int, content) -> str:
+        m = self.mirrors[doc]
+        t = content.type
+        kind = type(t).__name__
+        child_seg = m.segments.get((None, None, row))
+        if kind == "YXmlElement":
+            # sorted-attribute serialization (reference YXmlElement.js:97-113)
+            attrs = self._map_json_of(doc, None, row)
+            attrs_string = " ".join(
+                f'{key}="{attrs[key]}"' for key in sorted(attrs.keys())
+            )
+            node_name = t.node_name.lower()
+            inner = (
+                self._xml_children(doc, child_seg)
+                if child_seg is not None
+                else ""
+            )
+            sep = " " + attrs_string if attrs_string else ""
+            return f"<{node_name}{sep}>{inner}</{node_name}>"
+        if kind == "YXmlText":
+            # delta attributes as nested sorted tags (YXmlText.js:65-97)
+            if child_seg is None:
+                return ""
+            out = []
+            for delta in self._delta_of_seg(doc, child_seg):
+                names = sorted(delta.get("attributes", {}))
+                s = ""
+                for node_name in names:
+                    s += f"<{node_name}"
+                    a = delta["attributes"][node_name]
+                    for key in sorted(a):
+                        s += f' {key}="{a[key]}"'
+                    s += ">"
+                s += str(delta["insert"])
+                for node_name in reversed(names):
+                    s += f"</{node_name}>"
+                out.append(s)
+            return "".join(out)
+        if kind == "YXmlFragment":
+            return (
+                self._xml_children(doc, child_seg)
+                if child_seg is not None
+                else ""
+            )
+        # YXmlHook: a YMap with a hook name — the reference's toString
+        # falls through Object.prototype; serialize it as the stable empty
+        # form on BOTH paths (the CPU fallback goes through xml_string's
+        # own renderer below, so modes agree)
+        return ""
 
     def map_json(self, doc: int, name: str | None = None) -> dict:
         """The visible {key: value} content of one root YMap (LWW winners,

@@ -195,6 +195,12 @@ class TpuProvider:
         self.flush()
         return self.engine.to_delta(self.doc_id(guid))
 
+    def xml_string(self, guid: str) -> str:
+        """XML serialization of the room's root fragment (reference
+        YXmlFragment.toString) — served from the mirror."""
+        self.flush()
+        return self.engine.xml_string(self.doc_id(guid))
+
     def state_vector(self, guid: str) -> dict[int, int]:
         self.flush()
         return self.engine.state_vector(self.doc_id(guid))
