@@ -268,3 +268,11 @@ class TestUpdateEmission:
         prov.receive_update("r", Y.encode_state_as_update(d, sv))
         prov.flush()
         assert observer.get_text("text").to_string() == d.get_text("text").to_string()
+
+
+def test_server_demo_runs():
+    """examples/server_demo.py is the documented end-to-end product loop;
+    keep it green."""
+    import examples.server_demo as demo
+
+    demo.main(n_rooms=4)
