@@ -189,8 +189,12 @@ def bench_b4_broadcast(n_docs: int) -> dict:
         )
 
     # readback barrier (block_until_ready does not synchronize on the axon
-    # tunnel backend): the transfer may not escape the timed window
-    np.asarray(lanes_d[:1])
+    # tunnel backend): the transfer may not escape the timed window.
+    # jax.device_get avoids compiling a slice program inside the timed
+    # region (a first-compile on the tunnel costs ~0.8s)
+    import jax
+
+    jax.device_get(lanes_d)
     t_pack = time.perf_counter() - t0
 
     step = lambda dyn: kernels.apply_plan_shared(dyn, lanes_d, k_l, k_h, k_d)
