@@ -32,7 +32,7 @@ def _build() -> bool:
     try:
         srcs = [_SRC] + ([_SRC_PLAN] if os.path.exists(_SRC_PLAN) else [])
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO] + srcs,
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO] + srcs,
             check=True,
             capture_output=True,
             timeout=240,

@@ -92,8 +92,10 @@ struct Plan {
   int64_t n_levels = 0;
   int64_t max_width = 0;
   // bulk-apply form: FINAL link/head values of everything this step
-  // changed (host-resolved YATA; see Mirror::list_insert)
-  std::set<int64_t> dirty_links, dirty_heads;
+  // changed (host-resolved YATA; see Mirror::list_insert).  Dedup rides
+  // epoch marks in the Mirror (mark_link/mark_head); the finalize pass
+  // sorts, matching the Python twin's `sorted(plan._dl)`.
+  std::vector<int64_t> dirty_links, dirty_heads;
   std::vector<int64_t> link_rows, link_vals, head_segs, head_vals;
 
   void clear() {
@@ -163,6 +165,19 @@ struct Mirror {
   Plan plan;
   uint64_t gen = 0;
 
+  // dedup epochs for Plan.dirty_links/dirty_heads (one bump per prepare);
+  // tm_mark dedups the touched-map-segs list in the rows loop
+  std::vector<uint64_t> dl_mark, dh_mark, tm_mark;
+  uint64_t dirty_epoch = 0;
+  // list_insert conflict-scan marks: visited-walk id + visit order per row
+  // (replaces two std::set<int64_t> per insert with O(1) membership)
+  std::vector<uint64_t> walk_mark, walk_order;
+  uint64_t walk_id = 0;
+  // bump-allocated arena chunk for small synthesized buffers (surrogate
+  // repairs); chunks live in `owned`, so their bytes never move
+  int64_t cur_chunk = kNull;
+  size_t chunk_used = 0;
+
   // ---- interning / slots / segments -------------------------------------
 
   int64_t intern(const uint8_t* p, int64_t n) {
@@ -177,15 +192,27 @@ struct Mirror {
     return id;
   }
 
+  // one-entry cache: consecutive refs overwhelmingly share a client
+  // (slots are never removed, so the cache can only go stale on value,
+  // never on existence)
+  int64_t last_slot_client = INT64_MIN, last_slot_val = kNull;
+
   int64_t slot(int64_t client) {
+    if (client == last_slot_client) return last_slot_val;
+    int64_t s;
     auto it = slot_of_client.find(client);
-    if (it != slot_of_client.end()) return it->second;
-    int64_t s = (int64_t)client_of_slot.size();
-    slot_of_client.emplace(client, s);
-    client_of_slot.push_back(client);
-    frag_clock.emplace_back();
-    frag_row.emplace_back();
-    state.push_back(0);
+    if (it != slot_of_client.end()) {
+      s = it->second;
+    } else {
+      s = (int64_t)client_of_slot.size();
+      slot_of_client.emplace(client, s);
+      client_of_slot.push_back(client);
+      frag_clock.emplace_back();
+      frag_row.emplace_back();
+      state.push_back(0);
+    }
+    last_slot_client = client;
+    last_slot_val = s;
     return s;
   }
 
@@ -229,6 +256,47 @@ struct Mirror {
 
   const uint8_t* buf_ptr(int64_t b) const { return bufs[(size_t)b].first; }
   uint64_t buf_len(int64_t b) const { return bufs[(size_t)b].second; }
+
+  // two-part copy into the bump arena (surrogate repair buffers); avoids
+  // a malloc'd std::vector per synthesized fragment
+  static constexpr size_t kChunk = 1 << 16;
+  int64_t arena2(const uint8_t* a, size_t na, const uint8_t* b, size_t nb) {
+    size_t need = na + nb;
+    if (need > kChunk) {
+      std::vector<uint8_t> big;
+      big.reserve(need);
+      big.insert(big.end(), a, a + na);
+      big.insert(big.end(), b, b + nb);
+      return arena(std::move(big));
+    }
+    if (cur_chunk == kNull || chunk_used + need > kChunk) {
+      owned.push_back(std::make_unique<std::vector<uint8_t>>(kChunk));
+      cur_chunk = (int64_t)owned.size() - 1;
+      chunk_used = 0;
+    }
+    uint8_t* dst = owned[(size_t)cur_chunk]->data() + chunk_used;
+    std::memcpy(dst, a, na);
+    if (nb) std::memcpy(dst + na, b, nb);
+    chunk_used += need;
+    bufs.emplace_back(dst, (uint64_t)need);
+    return (int64_t)bufs.size() - 1;
+  }
+
+  // dedup'd dirty-row / dirty-head notes (sorted once at plan finalize)
+  void mark_link(int64_t row) {
+    if ((size_t)row >= dl_mark.size()) dl_mark.resize((size_t)row + 64, 0);
+    if (dl_mark[(size_t)row] != dirty_epoch) {
+      dl_mark[(size_t)row] = dirty_epoch;
+      plan.dirty_links.push_back(row);
+    }
+  }
+  void mark_head(int64_t sg) {
+    if ((size_t)sg >= dh_mark.size()) dh_mark.resize((size_t)sg + 64, 0);
+    if (dh_mark[(size_t)sg] != dirty_epoch) {
+      dh_mark[(size_t)sg] = dirty_epoch;
+      plan.dirty_heads.push_back(sg);
+    }
+  }
 
   // ---- content descriptor splitting -------------------------------------
 
@@ -299,14 +367,12 @@ struct Mirror {
         }
         // the cut consumed a surrogate pair: left = prefix + U+FFFD,
         // right = U+FFFD + suffix (both synthesized into arena buffers)
-        std::vector<uint8_t> lbytes(buf_ptr(c.buf) + c.ofs,
-                                    buf_ptr(c.buf) + (cut - 4));
-        lbytes.insert(lbytes.end(), {0xEF, 0xBF, 0xBD});
-        std::vector<uint8_t> rbytes{0xEF, 0xBF, 0xBD};
-        rbytes.insert(rbytes.end(), buf_ptr(c.buf) + cut,
-                      buf_ptr(c.buf) + c.end);
-        int64_t lb = arena(std::move(lbytes));
-        int64_t rb = arena(std::move(rbytes));
+        static const uint8_t kFFFD[3] = {0xEF, 0xBF, 0xBD};
+        const uint8_t* base = buf_ptr(c.buf);
+        int64_t lb = arena2(base + c.ofs, (size_t)(cut - 4 - (uint64_t)c.ofs),
+                            kFFFD, 3);
+        int64_t rb = arena2(kFFFD, 3, base + cut,
+                            (size_t)((uint64_t)c.end - cut));
         c.buf = lb; c.ofs = 0; c.end = (int64_t)buf_len(lb);
         right.kind = kKindUtf8;
         right.buf = rb; right.ofs = 0; right.end = (int64_t)buf_len(rb);
@@ -393,6 +459,13 @@ struct Mirror {
   // index into the frag lists of the fragment covering `clock`, or -1
   int64_t frag_containing(int64_t slot_, int64_t clock) const {
     const auto& fc = frag_clock[slot_];
+    if (fc.empty()) return kNull;
+    // fast path: appends dominate, so most lookups hit the last fragment
+    if (clock >= fc.back()) {
+      int64_t i = (int64_t)fc.size() - 1;
+      int64_t row = frag_row[slot_][(size_t)i];
+      return clock < r_clock[row] + r_len[row] ? i : kNull;
+    }
     auto it = std::upper_bound(fc.begin(), fc.end(), clock);
     int64_t i = (int64_t)(it - fc.begin()) - 1;
     if (i < 0) return kNull;
@@ -419,8 +492,8 @@ struct Mirror {
     plan.splits.push_back({{row, new_row}});
     list_next[new_row] = list_next[row];
     list_next[row] = new_row;
-    plan.dirty_links.insert(row);
-    plan.dirty_links.insert(new_row);
+    mark_link(row);
+    mark_link(new_row);
     if (r_host_deleted[row]) {
       r_host_deleted[new_row] = 1;
       // ship the fragment's deleted bit: the bulk-apply path has no
@@ -464,43 +537,55 @@ struct Mirror {
   // the same itemsBeforeOrigin/conflictingItems walk).  Returns the
   // resolved left row (kNull = new head).
   int64_t list_insert(int64_t sg, int64_t row, int64_t left_row,
-                      int64_t right_row, Plan* p) {
+                      int64_t right_row) {
     int64_t left = left_row;
     int64_t o = left_row != kNull ? list_next[left_row] : head_of_seg[sg];
-    std::set<int64_t> items_before, conflicting;
-    while (o != kNull && o != right_row) {
-      items_before.insert(o);
-      conflicting.insert(o);
-      if (row_origin_eq(row, o)) {
-        if (row_client(o) < row_client(row)) {
-          left = o;
-          conflicting.clear();
-        } else if (row_right_eq(row, o)) {
-          break;
-        }
-      } else {
-        int64_t oor = origin_row_of(o);
-        if (oor != kNull && items_before.count(oor)) {
-          if (!conflicting.count(oor)) {
+    if (o != kNull && o != right_row) {
+      // conflict scan with O(1) membership: `items_before` = rows stamped
+      // with this walk id; `conflicting` = those with visit order >=
+      // conf_start (clear() == bump conf_start past the current row).
+      // Stale stamps (older walks, pre-compaction ids) are always < the
+      // freshly bumped walk id, so lazy sizing is safe.
+      if (walk_mark.size() < r_slot.size()) {
+        walk_mark.resize(r_slot.size(), 0);
+        walk_order.resize(r_slot.size(), 0);
+      }
+      uint64_t wid = ++walk_id;
+      uint64_t idx = 0, conf_start = 0;
+      while (o != kNull && o != right_row) {
+        walk_mark[(size_t)o] = wid;
+        walk_order[(size_t)o] = idx++;
+        if (row_origin_eq(row, o)) {
+          if (row_client(o) < row_client(row)) {
             left = o;
-            conflicting.clear();
+            conf_start = idx;
+          } else if (row_right_eq(row, o)) {
+            break;
           }
         } else {
-          break;
+          int64_t oor = origin_row_of(o);
+          if (oor != kNull && walk_mark[(size_t)oor] == wid) {
+            if (walk_order[(size_t)oor] < conf_start) {
+              left = o;
+              conf_start = idx;
+            }
+          } else {
+            break;
+          }
         }
+        o = list_next[o];
       }
-      o = list_next[o];
     }
     if (left != kNull) {
       list_next[row] = list_next[left];
       list_next[left] = row;
-      p->dirty_links.insert(left);
-      p->dirty_links.insert(row);
+      mark_link(left);
+      mark_link(row);
     } else {
       list_next[row] = head_of_seg[sg];
       head_of_seg[sg] = row;
-      p->dirty_links.insert(row);
-      p->dirty_heads.insert(sg);
+      mark_link(row);
+      mark_head(sg);
     }
     return left;
   }
@@ -859,29 +944,21 @@ struct Mirror {
       t0 = t1;
     };
     plan.clear();
+    dirty_epoch++;
 
     // decode every staged update first (nothing merges on error; the doc
-    // demotes wholesale, matching the Python flow)
-    std::vector<std::pair<int64_t, std::vector<PendRef>>> incoming;  // client order
-    std::unordered_map<int64_t, size_t> incoming_idx;
+    // demotes wholesale, matching the Python flow).  Refs scan into ONE
+    // flat buffer and move into the per-client queues afterwards — a
+    // single fat-struct copy instead of the old scan/group/insert three.
+    std::vector<PendRef> all_refs;
+    all_refs.reserve((size_t)n_updates * 16);
     std::vector<std::array<int64_t, 3>> ds_ranges(pending_ds);
     {
-      std::vector<PendRef> refs;
       std::vector<std::array<int64_t, 3>> ds_new;
       for (int64_t i = 0; i < n_updates; i++) {
-        refs.clear();
         std::vector<std::array<int64_t, 3>> ds_one;
-        int rc = scan_update(buf_ids[i], v2_flags[i] != 0, &refs, &ds_one);
+        int rc = scan_update(buf_ids[i], v2_flags[i] != 0, &all_refs, &ds_one);
         if (rc != 0) return rc;
-        for (auto& p : refs) {
-          auto it = incoming_idx.find(p.client);
-          if (it == incoming_idx.end()) {
-            incoming_idx.emplace(p.client, incoming.size());
-            incoming.push_back({p.client, {p}});
-          } else {
-            incoming[it->second].second.push_back(p);
-          }
-        }
         for (auto& d : ds_one) ds_new.push_back(d);
       }
       for (auto& d : ds_new) ds_ranges.push_back(d);
@@ -889,22 +966,40 @@ struct Mirror {
     lap("scan");
     pending_ds.clear();
 
-    // merge incoming into the pending queues, clock-sorted (stable).
-    // The common case — one ordered update per client, empty queue — is
-    // already sorted; skip the fat-struct stable_sort then.
-    for (auto& [client, rs] : incoming) {
-      auto& q = pending[client];
-      q.insert(q.end(), rs.begin(), rs.end());
+    // merge into the pending queues, clock-sorted (stable).  The common
+    // case — one ordered update per client, empty queue — is already
+    // sorted; skip the fat-struct stable_sort then.  Relative per-client
+    // order of all_refs matches the old grouped flow (scan order).
+    {
+      int64_t last_client = INT64_MIN;
+      std::vector<PendRef>* q = nullptr;
+      std::vector<std::vector<PendRef>*> touched;
+      for (auto& p : all_refs) {
+        if (p.client != last_client || q == nullptr) {
+          last_client = p.client;
+          q = &pending[p.client];
+          if (std::find(touched.begin(), touched.end(), q) == touched.end())
+            touched.push_back(q);
+        }
+        q->push_back(std::move(p));
+      }
+      all_refs.clear();
       auto by_clock = [](const PendRef& a, const PendRef& b) {
         return a.clock < b.clock;
       };
-      if (!std::is_sorted(q.begin(), q.end(), by_clock))
-        std::stable_sort(q.begin(), q.end(), by_clock);
+      for (auto* qq : touched)
+        if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
+          std::stable_sort(qq->begin(), qq->end(), by_clock);
     }
 
     lap("merge");
     // causal scheduling: per-client queue fixpoint, descending client order
     std::vector<PendRef> sched;
+    {
+      size_t tot = 0;
+      for (auto& [c, q] : pending) tot += q.size();
+      sched.reserve(tot);
+    }
     std::unordered_map<int64_t, int64_t> overlay;
     auto state_of = [&](int64_t client) {
       auto it = overlay.find(client);
@@ -986,70 +1081,54 @@ struct Mirror {
     std::vector<int64_t> cut_clients;  // first-need order (Python dict order)
     std::unordered_map<int64_t, std::vector<int64_t>> cuts;
     cuts.reserve(16);
+    // one-entry cache (consecutive refs share clients) + consecutive-dup
+    // elision: the sort+unique below makes dropped dups unobservable
+    int64_t cut_cl_cache = INT64_MIN;
+    std::vector<int64_t>* cut_ks_cache = nullptr;
     auto need_start = [&](int64_t client, int64_t clock) {
-      auto it = cuts.find(client);
-      if (it == cuts.end()) {
-        cut_clients.push_back(client);
-        cuts[client].push_back(clock);
-      } else {
-        it->second.push_back(clock);
+      if (client != cut_cl_cache) {
+        auto it = cuts.find(client);
+        if (it == cuts.end()) {
+          cut_clients.push_back(client);
+          it = cuts.emplace(client, std::vector<int64_t>()).first;
+        }
+        cut_cl_cache = client;
+        cut_ks_cache = &it->second;
       }
+      if (cut_ks_cache->empty() || cut_ks_cache->back() != clock)
+        cut_ks_cache->push_back(clock);
     };
+    // per-stream repeat elision: origin cuts chain forward one at a time
+    // and right-origin cuts repeat across a typing burst, so most points
+    // equal the stream's previous one; sort+unique makes drops invisible
+    int64_t lo_cl = INT64_MIN, lo_k = INT64_MIN;
+    int64_t lr_cl = INT64_MIN, lr_k = INT64_MIN;
     for (auto& ref : sched) {
-      if (ref.oc >= 0) need_start(ref.oc, ref.ok + 1);
-      if (ref.rc >= 0) need_start(ref.rc, ref.rk);
+      if (ref.oc >= 0 && !(ref.oc == lo_cl && ref.ok + 1 == lo_k)) {
+        lo_cl = ref.oc;
+        lo_k = ref.ok + 1;
+        need_start(lo_cl, lo_k);
+      }
+      if (ref.rc >= 0 && !(ref.rc == lr_cl && ref.rk == lr_k)) {
+        lr_cl = ref.rc;
+        lr_k = ref.rk;
+        need_start(lr_cl, lr_k);
+      }
     }
     for (auto& [client, clock, ln] : applicable) {
       need_start(client, clock);
       need_start(client, clock + ln);
     }
+    lap("cuts-collect");
     for (auto& [client, ks] : cuts) {
-      std::sort(ks.begin(), ks.end());
+      // mostly-ascending in practice (origins chain forward); skip the
+      // sort when the scan produced them in order
+      if (!std::is_sorted(ks.begin(), ks.end()))
+        std::sort(ks.begin(), ks.end());
       ks.erase(std::unique(ks.begin(), ks.end()), ks.end());
     }
 
     lap("cuts");
-    // cuts inside scheduled refs: fragment the refs themselves.
-    // (Python walks per client over its sched indices; equivalent here:
-    // per ref, split by its own client's cut set — index order preserved
-    // because replacement happens in place per sched position.)
-    std::vector<PendRef> frag_sched;
-    frag_sched.reserve(sched.size());
-    for (auto& ref0 : sched) {
-      auto it = cuts.find(ref0.client);
-      if (it == cuts.end() || ref0.is_gc) {
-        frag_sched.push_back(ref0);
-        continue;
-      }
-      PendRef cur = ref0;
-      bool any = false;
-      auto& ks = it->second;
-      for (auto kit = std::upper_bound(ks.begin(), ks.end(), cur.clock);
-           kit != ks.end() && *kit < ref0.clock + ref0.length; ++kit) {
-        int64_t k = *kit;
-        if (k <= cur.clock) continue;
-        // split cur at k
-        PendRef right = cur;
-        int64_t off = k - cur.clock;
-        bool ok = true;
-        if (cur.c.kind != kKindNone) {
-          right.c = desc_split(cur.c, cur.length, off, &ok);
-          if (!ok) return kErrMalformed;
-        }
-        right.clock = cur.clock + off;
-        right.length = cur.length - off;
-        right.oc = cur.client;
-        right.ok = right.clock - 1;
-        cur.length = off;
-        frag_sched.push_back(cur);
-        cur = right;
-        any = true;
-      }
-      frag_sched.push_back(cur);
-      (void)any;
-    }
-
-    lap("frag-sched");
     // cuts inside existing rows: split + device link surgery
     size_t pre_split_marker = plan.splits.size();
     for (int64_t client : cut_clients) {
@@ -1074,16 +1153,18 @@ struct Mirror {
               });
 
     lap("pre-split");
-    // row assignment + pointer resolution
-    reserve_rows(frag_sched.size());
+    // row assignment + pointer resolution, fragmenting each scheduled ref
+    // by its client's cut set inline (same fragment order as the old
+    // two-pass frag_sched build, without the fat-struct copy pass)
+    reserve_rows(sched.size());
     std::vector<int64_t> touched_map_segs;  // ascending on use (set below)
-    std::set<int64_t> touched_set;
-    for (auto& ref : frag_sched) {
+    if (tm_mark.size() < dh_mark.size()) tm_mark.resize(dh_mark.size(), 0);
+    auto emit_row = [&](const PendRef& ref) -> int {
       int64_t slot_ = slot(ref.client);
       if (ref.is_gc) {
         add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
                 ContentDesc{}, 0, kNull);
-        continue;
+        return 0;
       }
       int64_t left_row = kNull, right_row = kNull;
       bool degrade = false;
@@ -1112,7 +1193,7 @@ struct Mirror {
       if (degrade) {
         add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
                 ContentDesc{}, 0, kNull);
-        continue;
+        return 0;
       }
       int64_t sg;
       if (parent_row != kNull) {
@@ -1129,7 +1210,7 @@ struct Mirror {
       int64_t row = add_row(slot_, ref.clock, ref.length, ref.oc, ref.ok,
                             ref.rc, ref.rk, false, ref.c, ref.ref, sg);
       plan.sched.push_back({{row, left_row, right_row, sg}});
-      int64_t actual_left = list_insert(sg, row, left_row, right_row, &plan);
+      int64_t actual_left = list_insert(sg, row, left_row, right_row);
       if (seg_is_map(sg)) {
         auto& chain = map_chain[sg];
         if (actual_left == kNull) {
@@ -1138,11 +1219,51 @@ struct Mirror {
           auto it = std::find(chain.begin(), chain.end(), actual_left);
           chain.insert(it + 1, row);
         }
-        if (touched_set.insert(sg).second) touched_map_segs.push_back(sg);
+        if ((size_t)sg >= tm_mark.size()) tm_mark.resize((size_t)sg + 64, 0);
+        if (tm_mark[(size_t)sg] != dirty_epoch) {
+          tm_mark[(size_t)sg] = dirty_epoch;
+          touched_map_segs.push_back(sg);
+        }
       }
       int64_t pr = seg_parent[sg];
       if (pr != kNull && r_host_deleted[pr]) delete_row(row);
-      if (ref.ref == 1) applicable.push_back({{ref.client, ref.clock, ref.length}});
+      if (ref.ref == 1)
+        applicable.push_back({{ref.client, ref.clock, ref.length}});
+      return 0;
+    };
+    for (auto& ref0 : sched) {
+      // length-1 refs can never be fragmented (no strictly-interior cut)
+      auto cit = (ref0.is_gc || ref0.length <= 1) ? cuts.end()
+                                                  : cuts.find(ref0.client);
+      if (cit == cuts.end()) {
+        int rc = emit_row(ref0);
+        if (rc != 0) return rc;
+        continue;
+      }
+      PendRef cur = ref0;
+      auto& ks = cit->second;
+      for (auto kit = std::upper_bound(ks.begin(), ks.end(), cur.clock);
+           kit != ks.end() && *kit < ref0.clock + ref0.length; ++kit) {
+        int64_t k = *kit;
+        if (k <= cur.clock) continue;
+        PendRef right = cur;
+        int64_t off = k - cur.clock;
+        bool ok = true;
+        if (cur.c.kind != kKindNone) {
+          right.c = desc_split(cur.c, cur.length, off, &ok);
+          if (!ok) return kErrMalformed;
+        }
+        right.clock = cur.clock + off;
+        right.length = cur.length - off;
+        right.oc = cur.client;
+        right.ok = right.clock - 1;
+        cur.length = off;
+        int rc = emit_row(cur);
+        if (rc != 0) return rc;
+        cur = right;
+      }
+      int rc = emit_row(cur);
+      if (rc != 0) return rc;
     }
 
     lap("rows");
@@ -1175,6 +1296,11 @@ struct Mirror {
     // path ships final links and skips the level assignment entirely
     if (want_levels) assign_levels();
     lap("levels");
+    // ascending row/seg order = the Python twin's `sorted(plan._dl)`
+    std::sort(plan.dirty_links.begin(), plan.dirty_links.end());
+    std::sort(plan.dirty_heads.begin(), plan.dirty_heads.end());
+    plan.link_rows.reserve(plan.dirty_links.size());
+    plan.link_vals.reserve(plan.dirty_links.size());
     for (int64_t r : plan.dirty_links) {
       plan.link_rows.push_back(r);
       plan.link_vals.push_back(list_next[(size_t)r]);
