@@ -117,6 +117,16 @@ def load():
         lib.ymx_buf_len.argtypes = [vp, i64]
         lib.ymx_prepare.restype = ctypes.c_int
         lib.ymx_prepare.argtypes = [vp, i64p, i64p, i64, ctypes.c_int, i64p]
+        vpp = ctypes.POINTER(vp)
+        lib.ymx_prepare_many.restype = None
+        lib.ymx_prepare_many.argtypes = [vpp, i64, i64p, i64p, i64p,
+                                         ctypes.c_int, i64p, i64p]
+        for pack_name in ("ymx_pack_apply", "ymx_pack_apply16"):
+            fn = getattr(lib, pack_name)
+            fn.restype = None
+            fn.argtypes = [vpp, i64p, i64, i64, i64, i64, i64, i64, i64,
+                           ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                           vp, i64p]
         for name, args in [
             ("ymx_plan_splits", [vp, i64p]),
             ("ymx_plan_sched", [vp, i64p]),

@@ -644,7 +644,10 @@ struct Mirror {
       uint64_t client = r.varuint();
       uint64_t clock = r.varuint();
       for (uint64_t s = 0; s < n_structs && !r.fail; s++) {
-        PendRef p;
+        // build in place: a 176-byte PendRef copy per struct is real
+        // memcpy traffic at millions of refs per flush
+        out->emplace_back();
+        PendRef& p = out->back();
         p.client = (int64_t)client;
         p.clock = (int64_t)clock;
         uint8_t info = r.u8();
@@ -764,7 +767,6 @@ struct Mirror {
         }
         if (r.fail) return kErrMalformed;
         if (p.length == 0 && ref != 0) return kErrMalformed;
-        out->push_back(p);
         clock += (uint64_t)p.length;
       }
     }
@@ -951,7 +953,13 @@ struct Mirror {
     // flat buffer and move into the per-client queues afterwards — a
     // single fat-struct copy instead of the old scan/group/insert three.
     std::vector<PendRef> all_refs;
-    all_refs.reserve((size_t)n_updates * 16);
+    {
+      // structs are >= ~4 wire bytes each; over-reserving transiently is
+      // far cheaper than re-copying 176-byte PendRefs on vector growth
+      uint64_t total_bytes = 0;
+      for (int64_t i = 0; i < n_updates; i++) total_bytes += buf_len(buf_ids[i]);
+      all_refs.reserve(total_bytes / 4 + 64);
+    }
     std::vector<std::array<int64_t, 3>> ds_ranges(pending_ds);
     {
       std::vector<std::array<int64_t, 3>> ds_new;
@@ -970,16 +978,47 @@ struct Mirror {
     // case — one ordered update per client, empty queue — is already
     // sorted; skip the fat-struct stable_sort then.  Relative per-client
     // order of all_refs matches the old grouped flow (scan order).
+    // Clients interleave ref-by-ref in merged updates, so the queue
+    // lookup rides a small linear cache (few clients), not a tree probe
+    // per switch.
     {
-      int64_t last_client = INT64_MIN;
-      std::vector<PendRef>* q = nullptr;
-      std::vector<std::vector<PendRef>*> touched;
+      // linear caches are faster than hashing for the common few-client
+      // case but quadratic past that; spill to a map when wide
+      constexpr size_t kLinearClients = 32;
+      std::vector<std::pair<int64_t, int64_t>> qcount;
+      std::unordered_map<int64_t, int64_t> qcount_wide;
       for (auto& p : all_refs) {
-        if (p.client != last_client || q == nullptr) {
-          last_client = p.client;
-          q = &pending[p.client];
-          if (std::find(touched.begin(), touched.end(), q) == touched.end())
-            touched.push_back(q);
+        if (qcount.size() >= kLinearClients) {
+          if (qcount_wide.empty())
+            qcount_wide.insert(qcount.begin(), qcount.end());
+          qcount_wide[p.client]++;
+          continue;
+        }
+        bool hit = false;
+        for (auto& [cl, n] : qcount)
+          if (cl == p.client) { n++; hit = true; break; }
+        if (!hit) qcount.emplace_back(p.client, 1);
+      }
+      const bool wide = !qcount_wide.empty();
+      std::vector<std::pair<int64_t, std::vector<PendRef>*>> qcache;
+      std::unordered_map<int64_t, std::vector<PendRef>*> qcache_wide;
+      auto reserve_q = [&](int64_t cl, int64_t n) {
+        auto* q = &pending[cl];
+        q->reserve(q->size() + (size_t)n);
+        if (wide) qcache_wide.emplace(cl, q);
+        else qcache.emplace_back(cl, q);
+      };
+      if (wide)
+        for (auto& [cl, n] : qcount_wide) reserve_q(cl, n);
+      else
+        for (auto& [cl, n] : qcount) reserve_q(cl, n);
+      for (auto& p : all_refs) {
+        std::vector<PendRef>* q = nullptr;
+        if (wide) {
+          q = qcache_wide[p.client];
+        } else {
+          for (auto& [cl, qp] : qcache)
+            if (cl == p.client) { q = qp; break; }
         }
         q->push_back(std::move(p));
       }
@@ -987,9 +1026,15 @@ struct Mirror {
       auto by_clock = [](const PendRef& a, const PendRef& b) {
         return a.clock < b.clock;
       };
-      for (auto* qq : touched)
-        if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
-          std::stable_sort(qq->begin(), qq->end(), by_clock);
+      if (wide) {
+        for (auto& [cl, qq] : qcache_wide)
+          if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
+            std::stable_sort(qq->begin(), qq->end(), by_clock);
+      } else {
+        for (auto& [cl, qq] : qcache)
+          if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
+            std::stable_sort(qq->begin(), qq->end(), by_clock);
+      }
     }
 
     lap("merge");
@@ -2284,6 +2329,163 @@ int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
   out_counts[12] = (int64_t)m->plan.link_rows.size();
   out_counts[13] = (int64_t)m->plan.head_segs.size();
   return 0;
+}
+
+// batched twin of ymx_prepare: one call plans EVERY staged doc, writing a
+// 16-wide counts row per doc ([0..13] = ymx_prepare's layout, [14] =
+// dense-link flag: link_rows == [0..n_rows)) and a per-doc rc.  Kills the
+// per-doc Python/ctypes round trip that dominated distinct-doc flushes.
+void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
+                      const int64_t* ids_flat, const int64_t* v2_flat,
+                      int want_levels, int64_t* out_counts, int64_t* out_rc) {
+  for (int64_t i = 0; i < n_docs; i++) {
+    Mirror* m = static_cast<Mirror*>(hs[i]);
+    int64_t lo = buf_ofs[i], hi = buf_ofs[i + 1];
+    int rc = m->prepare(ids_flat + lo, v2_flat + lo, hi - lo,
+                        want_levels != 0);
+    out_rc[i] = rc;
+    int64_t* c = out_counts + i * 16;
+    if (rc != 0) {
+      for (int j = 0; j < 16; j++) c[j] = 0;
+      continue;
+    }
+    int64_t depth = (int64_t)m->pending_ds.size();
+    for (auto& [cl, q] : m->pending) depth += (int64_t)q.size();
+    c[0] = m->plan.n_rows;
+    c[1] = (int64_t)m->plan.splits.size();
+    c[2] = (int64_t)m->plan.sched.size();
+    c[3] = (int64_t)m->plan.sched8.size();
+    c[4] = m->plan.n_levels;
+    c[5] = m->plan.max_width;
+    c[6] = (int64_t)m->plan.delete_rows.size();
+    c[7] = (int64_t)m->plan.applied_ds.size();
+    c[8] = (m->pending.empty() && m->pending_ds.empty()) ? 0 : 1;
+    c[9] = depth;
+    c[10] = (int64_t)m->client_of_slot.size();
+    c[11] = m->n_segs();
+    c[12] = (int64_t)m->plan.link_rows.size();
+    c[13] = (int64_t)m->plan.head_segs.size();
+    int64_t k = c[12];
+    c[14] = (k > 0 && k == m->plan.n_rows &&
+             m->plan.link_rows.back() == k - 1)
+                ? 1
+                : 0;
+    c[15] = 0;
+  }
+}
+
+// native twin of BatchEngine._flush_apply's pack loop: bins every doc's
+// plan into the per-shard scatter-lane layout
+//   [4*b_loc counts | k_dn dense vals | k_sp sparse rows | k_sp sparse
+//    vals | k_h head segs | k_h head vals | k_d delete rows]
+// writing pads (null/oob) for the unused tail of each section.  stats =
+// {n_dense, n_sparse, n_heads, n_dels} real lane elements.
+}  // extern "C"
+
+template <typename T>
+static void pack_apply_t(void** hs, const int64_t* doc_ids, int64_t n_plans,
+                         int64_t b_loc, int64_t n_shards, int64_t k_dn,
+                         int64_t k_sp, int64_t k_h, int64_t k_d, T oob_r,
+                         T oob_s, T null_val, T* lanes, int64_t* stats) {
+  int64_t lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d;
+  std::vector<int64_t> cur_dn(n_shards, 0), cur_sp(n_shards, 0),
+      cur_h(n_shards, 0), cur_d(n_shards, 0);
+  for (int64_t s = 0; s < n_shards; s++)
+    std::memset(lanes + s * lane_w, 0, (size_t)(4 * b_loc) * sizeof(T));
+  for (int64_t pi = 0; pi < n_plans; pi++) {
+    Mirror* m = static_cast<Mirror*>(hs[pi]);
+    Plan& p = m->plan;
+    int64_t i = doc_ids[pi];
+    int64_t s = i / b_loc, li = i % b_loc;
+    T* counts = lanes + s * lane_w;
+    T* dn = counts + 4 * b_loc;
+    T* sp_r = dn + k_dn;
+    T* sp_v = sp_r + k_sp;
+    T* hd_s = sp_v + k_sp;
+    T* hd_v = hd_s + k_h;
+    T* dl_r = hd_v + k_h;
+    int64_t k = (int64_t)p.link_rows.size();
+    bool dense = k > 0 && k == p.n_rows && p.link_rows.back() == k - 1;
+    if (dense) {
+      counts[0 * b_loc + li] = (T)k;
+      int64_t o = cur_dn[s];
+      for (int64_t j = 0; j < k; j++)
+        dn[o + j] = (T)p.link_vals[(size_t)j];
+      cur_dn[s] = o + k;
+    } else if (k) {
+      counts[1 * b_loc + li] = (T)k;
+      int64_t o = cur_sp[s];
+      for (int64_t j = 0; j < k; j++) {
+        sp_r[o + j] = (T)p.link_rows[(size_t)j];
+        sp_v[o + j] = (T)p.link_vals[(size_t)j];
+      }
+      cur_sp[s] = o + k;
+    }
+    int64_t hn = (int64_t)p.head_segs.size();
+    if (hn) {
+      counts[2 * b_loc + li] = (T)hn;
+      int64_t o = cur_h[s];
+      for (int64_t j = 0; j < hn; j++) {
+        hd_s[o + j] = (T)p.head_segs[(size_t)j];
+        hd_v[o + j] = (T)p.head_vals[(size_t)j];
+      }
+      cur_h[s] = o + hn;
+    }
+    int64_t dnn = (int64_t)p.delete_rows.size();
+    if (dnn) {
+      counts[3 * b_loc + li] = (T)dnn;
+      int64_t o = cur_d[s];
+      for (int64_t j = 0; j < dnn; j++)
+        dl_r[o + j] = (T)p.delete_rows[(size_t)j];
+      cur_d[s] = o + dnn;
+    }
+  }
+  stats[0] = stats[1] = stats[2] = stats[3] = 0;
+  for (int64_t s = 0; s < n_shards; s++) {
+    T* dn = lanes + s * lane_w + 4 * b_loc;
+    T* sp_r = dn + k_dn;
+    T* sp_v = sp_r + k_sp;
+    T* hd_s = sp_v + k_sp;
+    T* hd_v = hd_s + k_h;
+    T* dl_r = hd_v + k_h;
+    stats[0] += cur_dn[s];
+    stats[1] += cur_sp[s];
+    stats[2] += cur_h[s];
+    stats[3] += cur_d[s];
+    for (int64_t j = cur_dn[s]; j < k_dn; j++) dn[j] = null_val;
+    for (int64_t j = cur_sp[s]; j < k_sp; j++) {
+      sp_r[j] = oob_r;
+      sp_v[j] = null_val;
+    }
+    for (int64_t j = cur_h[s]; j < k_h; j++) {
+      hd_s[j] = oob_s;
+      hd_v[j] = null_val;
+    }
+    for (int64_t j = cur_d[s]; j < k_d; j++) dl_r[j] = oob_r;
+  }
+}
+
+extern "C" {
+
+void ymx_pack_apply(void** hs, const int64_t* doc_ids, int64_t n_plans,
+                    int64_t b_loc, int64_t n_shards, int64_t k_dn,
+                    int64_t k_sp, int64_t k_h, int64_t k_d, int32_t oob_r,
+                    int32_t oob_s, int32_t null_val, int32_t* lanes,
+                    int64_t* stats) {
+  pack_apply_t<int32_t>(hs, doc_ids, n_plans, b_loc, n_shards, k_dn, k_sp,
+                        k_h, k_d, oob_r, oob_s, null_val, lanes, stats);
+}
+
+// int16 twin: engines whose row/seg capacity fits 16 bits ship half the
+// flush bytes (the tunnel/PCIe link is the distinct-flush bottleneck)
+void ymx_pack_apply16(void** hs, const int64_t* doc_ids, int64_t n_plans,
+                      int64_t b_loc, int64_t n_shards, int64_t k_dn,
+                      int64_t k_sp, int64_t k_h, int64_t k_d, int32_t oob_r,
+                      int32_t oob_s, int32_t null_val, int16_t* lanes,
+                      int64_t* stats) {
+  pack_apply_t<int16_t>(hs, doc_ids, n_plans, b_loc, n_shards, k_dn, k_sp,
+                        k_h, k_d, (int16_t)oob_r, (int16_t)oob_s,
+                        (int16_t)null_val, lanes, stats);
 }
 
 void ymx_plan_links(void* h, int64_t* rows, int64_t* vals) {

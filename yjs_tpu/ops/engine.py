@@ -31,7 +31,12 @@ from ..core import Doc
 from ..lib0.u16 import from_u16
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
-from .native_mirror import NativeMirror, native_plan_available
+from .native_mirror import (
+    NativeMirror,
+    native_plan_available,
+    pack_apply_lanes,
+    prepare_many,
+)
 from . import kernels
 
 
@@ -392,17 +397,42 @@ class BatchEngine:
         old_cap, old_seg = self._cap, self._seg_cap
         self._cap = max(cap, self._cap)
         self._seg_cap = max(seg_cap, self._seg_cap)
-        new_right = np.full((b, self._cap + 1), NULL, np.int32)
-        new_deleted = np.zeros((b, self._cap + 1), bool)
-        new_starts = np.full((b, self._seg_cap + 1), NULL, np.int32)
-        if self._right is not None:
-            # old scratch region is reset to NULL by the fresh allocation
-            new_right[:, :old_cap] = np.asarray(self._right)[:, :old_cap]
-            new_deleted[:, :old_cap] = np.asarray(self._deleted)[:, :old_cap]
-            new_starts[:, :old_seg] = np.asarray(self._starts)[:, :old_seg]
-        self._right = self._put_b(new_right)
-        self._deleted = self._put_b(new_deleted)
-        self._starts = self._put_b(new_starts)
+
+        # allocate/grow ON DEVICE: jnp.full / device pad compile to tiny
+        # programs, where a host np.full + device_put ships B*(cap+1)
+        # int32s over the link (~6MB per 1024-doc engine — seconds of a
+        # tunneled backend's bandwidth, stealing the planner's host core)
+        def fresh(shape, fill, dtype):
+            arr = jnp.full(shape, fill, dtype)
+            if self._ns_batch is not None:
+                arr = jax.device_put(arr, self._ns_batch)
+            return arr
+
+        def grow(old, old_w, new_w, fill, dtype):
+            out = fresh((b, new_w), fill, dtype)
+            if old_w:
+                out = jax.lax.dynamic_update_slice(
+                    out, old[:, :old_w].astype(dtype), (0, 0)
+                )
+            if self._ns_batch is not None:
+                out = jax.device_put(out, self._ns_batch)
+            return out
+
+        if self._right is None:
+            self._right = fresh((b, self._cap + 1), NULL, jnp.int32)
+            self._deleted = fresh((b, self._cap + 1), False, jnp.bool_)
+            self._starts = fresh((b, self._seg_cap + 1), NULL, jnp.int32)
+        else:
+            # old scratch column (index old_cap) resets to padding
+            self._right = grow(
+                self._right, old_cap, self._cap + 1, NULL, jnp.int32
+            )
+            self._deleted = grow(
+                self._deleted, old_cap, self._cap + 1, False, jnp.bool_
+            )
+            self._starts = grow(
+                self._starts, old_seg, self._seg_cap + 1, NULL, jnp.int32
+            )
         # grow the resident statics device-side (pad, no host round trip).
         # Allocation is lazy: the bulk-apply path never reads them on
         # device, so an apply-only engine spends no HBM or transfer on
@@ -552,19 +582,41 @@ class BatchEngine:
         if not mode:
             mode = "apply"
         want_levels = mode != "apply"
+        # bulk path + native planner: ONE ymx_prepare_many call plans every
+        # staged doc (the per-doc ctypes loop was 72% of distinct-doc e2e,
+        # BENCH_r03); levels/seq and the Python mirror keep the doc loop
+        # gate on planner availability, not any particular doc's mirror: a
+        # demoted doc 0 must not silently disable the fast path fleet-wide
+        use_batch = (
+            not want_levels
+            and native_plan_available()
+            and any(isinstance(m, NativeMirror) for m in self.mirrors)
+        )
+        work: list = []  # batched path: (doc, mirror)
         with _phase("plan"):
-            for i, m in enumerate(self.mirrors):
-                if i in self.fallback:
-                    continue
-                if not m._incoming and not m.has_pending():
-                    continue  # idle doc: nothing to plan, upload, or emit
-                if emitting or i in observing:
-                    pre_svs[i] = m.state_vector()
-                try:
-                    plans[i] = m.prepare_step(want_levels=want_levels)
-                except UnsupportedUpdate as e:
-                    self._demote(i, pre_svs.get(i), reason=str(e))
-                    demoted_now += 1
+            if use_batch:
+                for i, m in enumerate(self.mirrors):
+                    if i in self.fallback or not isinstance(m, NativeMirror):
+                        continue
+                    if not m._incoming and not m._had_pending:
+                        continue  # idle doc: nothing to plan or emit
+                    if emitting or i in observing:
+                        pre_svs[i] = m.state_vector()
+                    work.append((i, m))
+                plans = dict(work)  # presence for the empty-flush check
+            else:
+                for i, m in enumerate(self.mirrors):
+                    if i in self.fallback:
+                        continue
+                    if not m._incoming and not m.has_pending():
+                        continue  # idle doc: nothing to plan, upload, or emit
+                    if emitting or i in observing:
+                        pre_svs[i] = m.state_vector()
+                    try:
+                        plans[i] = m.prepare_step(want_levels=want_levels)
+                    except UnsupportedUpdate as e:
+                        self._demote(i, pre_svs.get(i), reason=str(e))
+                        demoted_now += 1
         t_plan = time.perf_counter()
         # one schema for both exits: the normal path overwrites the measured
         # fields below, so the metrics dict cannot drift between the two
@@ -589,6 +641,11 @@ class BatchEngine:
         if not plans:
             metrics["t_total_s"] = time.perf_counter() - t_start
             self.last_flush_metrics = metrics
+            return
+        if use_batch:
+            self._flush_apply_batched(
+                work, pre_svs, emitting, metrics, t_start
+            )
             return
         if mode == "apply":
             self._flush_apply(plans, pre_svs, emitting, metrics, t_start, t_plan)
@@ -757,6 +814,161 @@ class BatchEngine:
                     for cb in cbs:
                         cb(i, events)
 
+    def _dispatch_lanes(self, lanes, key):
+        """Apply one packed lanes block to the device state (meshed or
+        not) — the single dispatch point shared by both bulk paths."""
+        k_dn, k_sp, k_h, k_d = key
+        self._metrics_dev = None
+        dyn = (self._right, self._deleted, self._starts)
+        if self.mesh is not None:
+            fn = self._sharded_apply.get(key)
+            if fn is None:
+                from ..parallel.mesh import sharded_apply_plan
+
+                fn = sharded_apply_plan(
+                    self.mesh, self.mesh.axis_names[0], *key
+                )
+                self._sharded_apply[key] = fn
+            dyn, self._metrics_dev = fn(dyn, self._put_b(lanes))
+        else:
+            dyn = kernels.apply_plan2(
+                dyn, self._put_r(lanes[0]), k_dn, k_sp, k_h, k_d
+            )
+        self._right, self._deleted, self._starts = dyn
+
+    def _flush_apply_batched(self, work, pre_svs, emitting, metrics, t_start):
+        """Native twin of :meth:`_flush_apply` with CHUNKED OVERLAP: the
+        doc list is planned (ymx_prepare_many), packed (ymx_pack_apply),
+        and dispatched in chunks, so chunk k's lanes transfer streams to
+        the device while the host planner runs chunk k+1 — the transfer
+        no longer serializes behind the full plan pass.  Zero per-doc
+        Python anywhere in the plan/pack path."""
+        chunk_sz = int(os.environ.get("YTPU_FLUSH_CHUNK", "256"))
+        b = self.n_docs
+        n_shards = 1 if self.mesh is None else self.mesh.shape[
+            self.mesh.axis_names[0]
+        ]
+        b_loc = b // n_shards
+        t_plan_acc = t_pack_acc = t_disp_acc = 0.0
+        stats_tot = np.zeros(4, np.int64)
+        lanes_padded_tot = 0
+        work_ok: list = []  # (doc, mirror, counts-row) across all chunks
+        demoted_now = metrics["n_demoted"]
+        max_rows_all = 0
+        for c0 in range(0, len(work), chunk_sz):
+            chunk = work[c0 : c0 + chunk_sz]
+            t0 = time.perf_counter()
+            counts_all, rcs, staged_info = prepare_many(
+                chunk, want_levels=False
+            )
+            chunk_ok: list = []
+            for k, (i, m) in enumerate(chunk):
+                try:
+                    m._finish_prepare(
+                        int(rcs[k]), staged_info[k][0], staged_info[k][1],
+                        counts_all[k],
+                    )
+                except UnsupportedUpdate as e:
+                    self._demote(i, pre_svs.get(i), reason=str(e))
+                    demoted_now += 1
+                else:
+                    chunk_ok.append((i, m, counts_all[k]))
+            t1 = time.perf_counter()
+            t_plan_acc += t1 - t0
+            if not chunk_ok:
+                continue
+            counts = np.stack([c for _, _, c in chunk_ok])
+            doc_idx = np.asarray([i for i, _, _ in chunk_ok], np.int64)
+            max_rows = int(counts[:, 0].max(initial=0))
+            max_rows_all = max(max_rows_all, max_rows)
+            self._ensure_capacity(max_rows, int(counts[:, 11].max(initial=0)))
+            oob_r = int(self._cap + 1)
+            oob_s = int(self._seg_cap + 1)
+            shard = doc_idx // b_loc
+            link = counts[:, 12]
+            dense = counts[:, 14].astype(bool)
+
+            def shard_max(values, mask, minimum, shard=shard):
+                sums = np.bincount(
+                    shard[mask], weights=values[mask].astype(np.float64),
+                    minlength=n_shards,
+                )
+                return _bucket(int(sums.max(initial=0)), minimum)
+
+            all_mask = np.ones(len(chunk_ok), bool)
+            k_dn = shard_max(link, dense, 64)
+            k_sp = shard_max(link, ~dense, 64)
+            k_h = shard_max(counts[:, 13], all_mask, 8)
+            k_d = shard_max(counts[:, 6], all_mask, 64)
+            # int16 lanes when every index/count fits: half the flush
+            # bytes over the host->device link (the distinct-path
+            # bottleneck on tunneled backends)
+            lane_dtype = (
+                np.int16
+                if max(oob_r, oob_s, int(link.max(initial=0))) <= 32767
+                else np.int32
+            )
+            lanes, stats = pack_apply_lanes(
+                chunk_ok, doc_idx, b_loc, n_shards, (k_dn, k_sp, k_h, k_d),
+                oob_r, oob_s, int(NULL), lane_dtype,
+            )
+            stats_tot += stats
+            lanes_padded_tot += k_dn + k_sp + k_h + k_d
+            # the apply path never reads the device statics; mark touched
+            # docs for full (re-)upload if a levels/seq flush ever runs
+            for i, _, _ in chunk_ok:
+                self._uploaded_rows[i] = 0
+            work_ok.extend(chunk_ok)
+            t2 = time.perf_counter()
+            t_pack_acc += t2 - t1
+            # async dispatch: the device consumes this chunk's lanes while
+            # the next loop iteration plans on the host
+            self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
+            t_disp_acc += time.perf_counter() - t2
+        metrics["n_demoted"] = demoted_now
+        t_dispatch = time.perf_counter()
+        with _phase("emit"):
+            # real plan objects only where the emit phase will read them:
+            # every doc when update listeners exist, observed docs for
+            # events; the log-compaction walk touches keys only
+            observed = self._event_listeners
+            plans = {
+                i: (m.make_plan(c) if emitting or i in observed else None)
+                for i, m, c in work_ok
+            }
+            self._emit_phase(plans, pre_svs, emitting)
+        t_emit = time.perf_counter()
+
+        if work_ok:
+            counts = np.stack([c for _, _, c in work_ok])
+        else:
+            counts = np.zeros((0, 16), np.int64)
+        n_dense, n_sparse, n_heads, n_dels = (int(x) for x in stats_tot)
+        lanes_real = n_dense + n_sparse + n_heads + n_dels
+        pending_mask = counts[:, 8] == 1
+        metrics.update({
+            "n_docs_flushed": int(
+                ((counts[:, 12] > 0) | (counts[:, 13] > 0)
+                 | (counts[:, 6] > 0)).sum()
+            ),
+            "n_rows_max": max_rows_all,
+            "n_sched_entries": n_dense + n_sparse,
+            "n_levels": 1,
+            "level_width": n_dense + n_sparse,
+            # bulk path: fraction of dispatched scatter lanes that are real
+            "schedule_occupancy": (
+                lanes_real / lanes_padded_tot if lanes_padded_tot else 0.0
+            ),
+            "n_pending_docs": int(pending_mask.sum()),
+            "pending_depth": int(counts[pending_mask, 9].sum()),
+            "t_plan_s": t_plan_acc,
+            "t_pack_s": t_pack_acc,
+            "t_dispatch_s": t_disp_acc,
+            "t_emit_s": t_emit - t_dispatch,
+            "t_total_s": t_emit - t_start,
+        })
+        self.last_flush_metrics = metrics
+
     def _flush_apply(self, plans, pre_svs, emitting, metrics, t_start, t_plan):
         """Bulk-apply dispatch: ship the planner's final link/head/delete
         values in ONE conflict-free scatter per array (host-resolved YATA;
@@ -851,24 +1063,7 @@ class BatchEngine:
                 self._uploaded_rows[i] = 0
         t_pack = time.perf_counter()
         with _phase("dispatch"):
-            self._metrics_dev = None
-            dyn = (self._right, self._deleted, self._starts)
-            if self.mesh is not None:
-                key = (k_dn, k_sp, k_h, k_d)
-                fn = self._sharded_apply.get(key)
-                if fn is None:
-                    from ..parallel.mesh import sharded_apply_plan
-
-                    fn = sharded_apply_plan(
-                        self.mesh, self.mesh.axis_names[0], *key
-                    )
-                    self._sharded_apply[key] = fn
-                dyn, self._metrics_dev = fn(dyn, self._put_b(lanes))
-            else:
-                dyn = kernels.apply_plan2(
-                    dyn, self._put_r(lanes[0]), k_dn, k_sp, k_h, k_d
-                )
-            self._right, self._deleted, self._starts = dyn
+            self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
         t_dispatch = time.perf_counter()
         with _phase("emit"):
             self._emit_phase(plans, pre_svs, emitting)

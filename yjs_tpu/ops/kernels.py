@@ -399,7 +399,11 @@ def apply_plan2(dyn, lanes, k_dn, k_sp, k_h, k_d):
 
 def apply_lanes(dyn, lanes, k_dn, k_sp, k_h, k_d):
     """The apply_plan2 body as a plain traceable function — reused by the
-    sharded mesh step (each shard applies its own lanes block locally)."""
+    sharded mesh step (each shard applies its own lanes block locally).
+
+    ``lanes`` may arrive int16 (engines whose row/seg capacity fits —
+    halves the flush transfer over tunneled links); widened on device."""
+    lanes = lanes.astype(jnp.int32)
     right_link, deleted, starts = dyn
     b = right_link.shape[0]
     n1 = right_link.shape[1]

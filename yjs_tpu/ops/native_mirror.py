@@ -197,6 +197,9 @@ class NativeMirror:
         self._py.realized_content = self.realized_content
         self._synced_gen = -1
         self._plan_seq = 0
+        # mirrors counts[8] of the last prepare: lets the engine skip the
+        # per-doc ymx_has_pending call when binning flush work
+        self._had_pending = False
         # extra per-row source columns the shadow DocMirror has no slot for
         self._src_ofs2: list[int] = []
         self._src_end2: list[int] = []
@@ -214,11 +217,9 @@ class NativeMirror:
     def ingest(self, update: bytes, v2: bool = False) -> None:
         self._incoming.append((update, v2))
 
-    def prepare_step(self, want_levels: bool | None = None) -> NativePlan:
-        # default matches DocMirror: compute the full plan (level schedule
-        # included); the engine passes want_levels=False on the bulk path
-        if want_levels is None:
-            want_levels = True
+    def _stage_bufs(self):
+        """Register the staged updates with the core; returns
+        (staged, buf_ids, v2_flags) with the facade pins recorded."""
         lib, h = self._lib, self._h
         staged = self._incoming
         n_up = len(staged)
@@ -232,13 +233,16 @@ class NativeMirror:
             self._py_bufs[int(bid)] = (u, arr)
             ids[j] = bid
             v2s[j] = 1 if v2 else 0
-        counts = np.zeros(14, np.int64)
-        rc = lib.ymx_prepare(
-            h, _p64(ids), _p64(v2s), n_up, 1 if want_levels else 0,
-            _p64(counts),
-        )
+        return staged, ids, v2s
+
+    def _finish_prepare(self, rc, staged, ids, counts) -> None:
+        """Post-prepare bookkeeping shared by the per-doc and batched
+        paths; raises exactly like the old inline prepare_step body."""
+        lib, h = self._lib, self._h
+        n_up = len(staged)
         self._incoming = []
         self._plan_seq += 1
+        self._had_pending = bool(counts[8])
         if rc == -9:
             raise UnsupportedUpdate("subdocument (content ref 9)")
         if rc != 0:
@@ -260,6 +264,24 @@ class NativeMirror:
                 raise
             raise UnsupportedUpdate(f"native plan: unsupported payload (rc={rc})")
         self._realized.clear()
+
+    def make_plan(self, counts) -> NativePlan:
+        """Wrap the core's current plan (valid until the next prepare)."""
+        return NativePlan(self._lib, self._h, counts, self)
+
+    def prepare_step(self, want_levels: bool | None = None) -> NativePlan:
+        # default matches DocMirror: compute the full plan (level schedule
+        # included); the engine passes want_levels=False on the bulk path
+        if want_levels is None:
+            want_levels = True
+        lib, h = self._lib, self._h
+        staged, ids, v2s = self._stage_bufs()
+        counts = np.zeros(16, np.int64)
+        rc = lib.ymx_prepare(
+            h, _p64(ids), _p64(v2s), len(staged), 1 if want_levels else 0,
+            _p64(counts),
+        )
+        self._finish_prepare(rc, staged, ids, counts)
         return NativePlan(lib, h, counts, self)
 
     @property
@@ -609,3 +631,74 @@ class NativeMirror:
             raise AttributeError(name)
         self._sync()
         return getattr(self.__dict__["_py"], name)
+
+
+def prepare_many(work, want_levels: bool = False):
+    """Batched ymx_prepare over many NativeMirrors in ONE native call.
+
+    ``work`` is a list of ``(doc_idx, NativeMirror)``.  Returns
+    ``(counts, rcs, staged_info)`` where ``counts`` is an ``(n, 16)``
+    int64 array (ymx_prepare layout + ``[14]`` = dense-link flag),
+    ``rcs`` the per-doc return codes, and ``staged_info`` the
+    per-doc ``(staged, ids)`` needed by ``_finish_prepare``.
+
+    Replaces the per-doc ctypes round trip that made the host planner
+    72% of distinct-doc flush time (BENCH_r03 host_phase_timers).
+    """
+    n = len(work)
+    lib = work[0][1]._lib
+    handles = (ctypes.c_void_p * n)()
+    buf_ofs = np.zeros(n + 1, np.int64)
+    ids_parts, v2_parts, staged_info = [], [], []
+    for k, (_i, m) in enumerate(work):
+        staged, ids, v2s = m._stage_bufs()
+        nb = len(staged)
+        staged_info.append((staged, ids))
+        buf_ofs[k + 1] = buf_ofs[k] + nb
+        if nb:
+            ids_parts.append(ids[:nb])
+            v2_parts.append(v2s[:nb])
+        handles[k] = m._h
+    ids_flat = (
+        np.concatenate(ids_parts) if ids_parts else np.zeros(1, np.int64)
+    )
+    v2_flat = (
+        np.concatenate(v2_parts) if v2_parts else np.zeros(1, np.int64)
+    )
+    counts = np.zeros((n, 16), np.int64)
+    rcs = np.zeros(n, np.int64)
+    lib.ymx_prepare_many(
+        handles, n, _p64(buf_ofs), _p64(ids_flat), _p64(v2_flat),
+        1 if want_levels else 0, _p64(counts), _p64(rcs),
+    )
+    return counts, rcs, staged_info
+
+
+def pack_apply_lanes(work, doc_ids, b_loc, n_shards, widths, oob_r, oob_s,
+                     null_val, dtype=np.int32):
+    """Fill the bulk-apply scatter lanes for ``work`` (post-prepare
+    ``(doc_idx, NativeMirror)`` entries, rc==0) natively.  Returns
+    ``(lanes, stats)`` with ``lanes`` shaped ``(n_shards, lane_w)`` and
+    ``stats = [n_dense, n_sparse, n_heads, n_dels]`` real elements —
+    the native twin of BatchEngine._flush_apply's pack loop.
+
+    ``dtype=np.int16`` halves the transfer when every row/seg index fits
+    16 bits (the caller checks capacity); the kernel widens on device."""
+    k_dn, k_sp, k_h, k_d = widths
+    n = len(work)
+    lib = work[0][1]._lib
+    handles = (ctypes.c_void_p * n)()
+    for k, (_i, m, *_rest) in enumerate(work):
+        handles[k] = m._h
+    lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
+    lanes = np.empty((n_shards, lane_w), dtype)
+    stats = np.zeros(4, np.int64)
+    ids = np.ascontiguousarray(doc_ids, np.int64)
+    fn = lib.ymx_pack_apply16 if dtype == np.int16 else lib.ymx_pack_apply
+    fn(
+        handles, _p64(ids), n, b_loc, n_shards, k_dn, k_sp, k_h, k_d,
+        ctypes.c_int32(oob_r), ctypes.c_int32(oob_s),
+        ctypes.c_int32(null_val),
+        lanes.ctypes.data_as(ctypes.c_void_p), _p64(stats),
+    )
+    return lanes, stats

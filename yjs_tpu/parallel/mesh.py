@@ -104,7 +104,7 @@ def sharded_apply_plan(mesh: Mesh, axis: str, k_dn: int, k_sp: int,
     spec = P(axis)
 
     def local_apply(dyn, lanes):
-        lanes1 = lanes[0]
+        lanes1 = lanes[0].astype(jnp.int32)  # int16 lanes widen on device
         b_loc = dyn[0].shape[0]
         out = kernels.apply_lanes(dyn, lanes1, k_dn, k_sp, k_h, k_d)
         integrated = jnp.sum(lanes1[: 2 * b_loc])  # dense + sparse counts
