@@ -935,7 +935,10 @@ struct Mirror {
   // ---- the flush pipeline (DocMirror.prepare_step twin) -----------------
 
   int prepare(const int64_t* buf_ids, const int64_t* v2_flags,
-              int64_t n_updates, bool want_levels) {
+              int64_t n_updates, bool want_levels, bool want_sched = true) {
+    // the bulk-apply path never reads the sched section unless events are
+    // observed; skipping it saves a 32-byte append per integrated row
+    want_sched = want_sched || want_levels;
     const bool timing = std::getenv("YMX_TIMING") != nullptr;
     auto t0 = std::chrono::steady_clock::now();
     auto lap = [&](const char* what) {
@@ -1145,19 +1148,42 @@ struct Mirror {
     };
     // per-stream repeat elision: origin cuts chain forward one at a time
     // and right-origin cuts repeat across a typing burst, so most points
-    // equal the stream's previous one; sort+unique makes drops invisible
-    int64_t lo_cl = INT64_MIN, lo_k = INT64_MIN;
-    int64_t lr_cl = INT64_MIN, lr_k = INT64_MIN;
-    for (auto& ref : sched) {
-      if (ref.oc >= 0 && !(ref.oc == lo_cl && ref.ok + 1 == lo_k)) {
-        lo_cl = ref.oc;
-        lo_k = ref.ok + 1;
-        need_start(lo_cl, lo_k);
+    // equal that client-stream's previous one; sort+unique makes drops
+    // invisible.  Keyed per client (refs interleave clients ref-by-ref,
+    // so a single-entry cache would thrash), linear scan over few clients.
+    std::vector<std::array<int64_t, 3>> last_cut;  // client, last_o, last_r
+    std::unordered_map<int64_t, std::array<int64_t, 2>> last_cut_wide;
+    constexpr size_t kLinearCutClients = 32;
+    auto cut_slot = [&](int64_t cl) -> int64_t* {
+      // linear for the common few-client case; spill to a map when refs
+      // span many historical clients (initial sync / bulk history load)
+      if (last_cut.size() >= kLinearCutClients) {
+        if (last_cut_wide.empty())
+          for (auto& e : last_cut)
+            last_cut_wide.emplace(e[0], std::array<int64_t, 2>{e[1], e[2]});
+        return last_cut_wide
+            .emplace(cl, std::array<int64_t, 2>{INT64_MIN, INT64_MIN})
+            .first->second.data();
       }
-      if (ref.rc >= 0 && !(ref.rc == lr_cl && ref.rk == lr_k)) {
-        lr_cl = ref.rc;
-        lr_k = ref.rk;
-        need_start(lr_cl, lr_k);
+      for (auto& e : last_cut)
+        if (e[0] == cl) return &e[1];
+      last_cut.push_back({cl, INT64_MIN, INT64_MIN});
+      return &last_cut.back()[1];
+    };
+    for (auto& ref : sched) {
+      if (ref.oc >= 0) {
+        int64_t* e = cut_slot(ref.oc);
+        if (e[0] != ref.ok + 1) {
+          e[0] = ref.ok + 1;
+          need_start(ref.oc, e[0]);
+        }
+      }
+      if (ref.rc >= 0) {
+        int64_t* e = cut_slot(ref.rc);
+        if (e[1] != ref.rk) {
+          e[1] = ref.rk;
+          need_start(ref.rc, ref.rk);
+        }
       }
     }
     for (auto& [client, clock, ln] : applicable) {
@@ -1254,7 +1280,7 @@ struct Mirror {
       }
       int64_t row = add_row(slot_, ref.clock, ref.length, ref.oc, ref.ok,
                             ref.rc, ref.rk, false, ref.c, ref.ref, sg);
-      plan.sched.push_back({{row, left_row, right_row, sg}});
+      if (want_sched) plan.sched.push_back({{row, left_row, right_row, sg}});
       int64_t actual_left = list_insert(sg, row, left_row, right_row);
       if (seg_is_map(sg)) {
         auto& chain = map_chain[sg];
@@ -2337,12 +2363,13 @@ int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
 // per-doc Python/ctypes round trip that dominated distinct-doc flushes.
 void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
                       const int64_t* ids_flat, const int64_t* v2_flat,
-                      int want_levels, int64_t* out_counts, int64_t* out_rc) {
+                      int want_levels, int want_sched, int64_t* out_counts,
+                      int64_t* out_rc) {
   for (int64_t i = 0; i < n_docs; i++) {
     Mirror* m = static_cast<Mirror*>(hs[i]);
     int64_t lo = buf_ofs[i], hi = buf_ofs[i + 1];
     int rc = m->prepare(ids_flat + lo, v2_flat + lo, hi - lo,
-                        want_levels != 0);
+                        want_levels != 0, want_sched != 0);
     out_rc[i] = rc;
     int64_t* c = out_counts + i * 16;
     if (rc != 0) {

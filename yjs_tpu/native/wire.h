@@ -21,8 +21,23 @@ struct Reader {
     return buf[pos++];
   }
 
-  // lib0 varuint (7 bits per byte, little-endian groups)
+  // lib0 varuint (7 bits per byte, little-endian groups).  Fast paths
+  // for the 1- and 2-byte encodings that dominate wire traffic.
   uint64_t varuint() {
+    if (pos < len) {
+      uint8_t r0 = buf[pos];
+      if (r0 < 0x80) {
+        pos++;
+        return r0;
+      }
+      if (pos + 1 < len) {
+        uint8_t r1 = buf[pos + 1];
+        if (r1 < 0x80) {
+          pos += 2;
+          return (uint64_t)(r0 & 0x7f) | ((uint64_t)r1 << 7);
+        }
+      }
+    }
     uint64_t num = 0;
     int shift = 0;
     while (true) {

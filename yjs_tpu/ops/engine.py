@@ -644,7 +644,8 @@ class BatchEngine:
             return
         if use_batch:
             self._flush_apply_batched(
-                work, pre_svs, emitting, metrics, t_start
+                work, pre_svs, emitting, metrics, t_start,
+                observed=set(observing),
             )
             return
         if mode == "apply":
@@ -787,10 +788,12 @@ class BatchEngine:
         })
         self.last_flush_metrics = metrics
 
-    def _emit_phase(self, plans, pre_svs, emitting) -> None:
+    def _emit_phase(self, plans, pre_svs, emitting, observed=None) -> None:
         """Post-dispatch host work shared by both dispatch paths: update-log
         compaction + doc.on('update') novelty emission (overlaps the async
-        device execution)."""
+        device execution).  ``observed`` restricts event computation to a
+        prepare-time listener snapshot (the batched path may not have
+        built plan.sched for docs unobserved at prepare)."""
         for i in plans:
             m = self.mirrors[i]
             if len(self._update_log[i]) > 64 and not m.has_pending():
@@ -804,6 +807,8 @@ class BatchEngine:
             from .events import compute_flush_events
 
             for i, p in plans.items():
+                if observed is not None and i not in observed:
+                    continue
                 cbs = self._event_listeners.get(i)
                 if not cbs:
                     continue
@@ -836,7 +841,9 @@ class BatchEngine:
             )
         self._right, self._deleted, self._starts = dyn
 
-    def _flush_apply_batched(self, work, pre_svs, emitting, metrics, t_start):
+    def _flush_apply_batched(
+        self, work, pre_svs, emitting, metrics, t_start, observed=frozenset()
+    ):
         """Native twin of :meth:`_flush_apply` with CHUNKED OVERLAP: the
         doc list is planned (ymx_prepare_many), packed (ymx_pack_apply),
         and dispatched in chunks, so chunk k's lanes transfer streams to
@@ -859,7 +866,10 @@ class BatchEngine:
             chunk = work[c0 : c0 + chunk_sz]
             t0 = time.perf_counter()
             counts_all, rcs, staged_info = prepare_many(
-                chunk, want_levels=False
+                chunk,
+                want_levels=False,
+                # events read plan.sched; skip building it otherwise
+                want_sched=bool(self._event_listeners),
             )
             chunk_ok: list = []
             for k, (i, m) in enumerate(chunk):
@@ -930,13 +940,16 @@ class BatchEngine:
         with _phase("emit"):
             # real plan objects only where the emit phase will read them:
             # every doc when update listeners exist, observed docs for
-            # events; the log-compaction walk touches keys only
-            observed = self._event_listeners
+            # events; the log-compaction walk touches keys only.  The
+            # observed set is the PREPARE-TIME snapshot: a listener
+            # registered mid-flush (e.g. from an update callback) sees
+            # events from the next flush — plan.sched for this one may
+            # not have been built (want_sched gate)
             plans = {
                 i: (m.make_plan(c) if emitting or i in observed else None)
                 for i, m, c in work_ok
             }
-            self._emit_phase(plans, pre_svs, emitting)
+            self._emit_phase(plans, pre_svs, emitting, observed=observed)
         t_emit = time.perf_counter()
 
         if work_ok:

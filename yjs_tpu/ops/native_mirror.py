@@ -633,7 +633,7 @@ class NativeMirror:
         return getattr(self.__dict__["_py"], name)
 
 
-def prepare_many(work, want_levels: bool = False):
+def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
     """Batched ymx_prepare over many NativeMirrors in ONE native call.
 
     ``work`` is a list of ``(doc_idx, NativeMirror)``.  Returns
@@ -641,6 +641,11 @@ def prepare_many(work, want_levels: bool = False):
     int64 array (ymx_prepare layout + ``[14]`` = dense-link flag),
     ``rcs`` the per-doc return codes, and ``staged_info`` the
     per-doc ``(staged, ids)`` needed by ``_finish_prepare``.
+
+    ``want_sched=False`` skips building each plan's sched section
+    (``NativePlan.sched`` then reads back empty) — ONLY safe when no
+    consumer will read it, e.g. the bulk-apply flush with no event
+    listeners; ``ymx_prepare``/``prepare_step`` always build it.
 
     Replaces the per-doc ctypes round trip that made the host planner
     72% of distinct-doc flush time (BENCH_r03 host_phase_timers).
@@ -669,7 +674,8 @@ def prepare_many(work, want_levels: bool = False):
     rcs = np.zeros(n, np.int64)
     lib.ymx_prepare_many(
         handles, n, _p64(buf_ofs), _p64(ids_flat), _p64(v2_flat),
-        1 if want_levels else 0, _p64(counts), _p64(rcs),
+        1 if want_levels else 0, 1 if want_sched else 0, _p64(counts),
+        _p64(rcs),
     )
     return counts, rcs, staged_info
 
