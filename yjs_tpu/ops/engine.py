@@ -1208,20 +1208,211 @@ class BatchEngine:
         rows, dels = self._order(doc, seg)
         return visible_text(m, rows, dels)
 
-    def to_delta(self, doc: int, name: str | None = None) -> list:
+    def to_delta(
+        self,
+        doc: int,
+        name: str | None = None,
+        snapshot=None,
+        prev_snapshot=None,
+        compute_ychange=None,
+    ) -> list:
         """Attributed rich-text delta of one root text type, straight from
         the mirror (reference YText.toDelta, YText.js:936-1030): format
         runs toggle current_attributes, strings/embeds emit insert ops —
-        no CPU-doc replay needed for rich-text consumers."""
+        no CPU-doc replay needed for rich-text consumers.
+
+        With ``snapshot`` (and optionally ``prev_snapshot``), renders the
+        point-in-time / two-snapshot diff view with ``ychange``
+        attribution (reference YText.js:936-1030 toDelta(snapshot,
+        prevSnapshot, computeYChange)) for DEVICE-RESIDENT rooms — the
+        mirror keeps deleted runs' content (engine default gc=False), so
+        history renders without demoting the doc."""
         name = name or self.root_name
         fb = self.fallback.get(doc)
         if fb is not None:
-            return fb.get_text(name).to_delta()
+            return fb.get_text(name).to_delta(
+                snapshot, prev_snapshot, compute_ychange
+            )
         m = self.mirrors[doc]
         seg = m.segments.get((name, None, NULL))
         if seg is None:
             return []
-        return self._delta_of_seg(doc, seg)
+        if snapshot is None and prev_snapshot is None:
+            return self._delta_of_seg(doc, seg)
+        return self._delta_of_seg_snapshot(
+            doc, seg, snapshot, prev_snapshot, compute_ychange
+        )
+
+    def snapshot(self, doc: int):
+        """Point-in-time capture (state vector + delete set) of one room,
+        straight from the mirror — no CPU-doc materialization, no device
+        round trip (reference Snapshot.js:118-121 snapshot()).  The
+        result is a standard :class:`~yjs_tpu.utils.snapshot.Snapshot`:
+        encode/decode/equality and createDocFromSnapshot interop apply."""
+        from ..utils.snapshot import create_snapshot
+        from ..utils.snapshot import snapshot as cpu_snapshot
+
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return cpu_snapshot(fb)
+        m = self.mirrors[doc]
+        return create_snapshot(m.delete_set(), m.state_vector())
+
+    def create_doc_from_snapshot(self, doc: int, snap, new_doc=None) -> Doc:
+        """Rewind one room to ``snap`` as a standalone CPU :class:`Doc`
+        (reference Snapshot.js:162-202 createDocFromSnapshot).  The room
+        itself stays device-resident and untouched; the engine's full
+        state is materialized host-side (gc=False history is retained by
+        default) and truncated to the snapshot."""
+        from ..updates import apply_update
+        from ..utils.snapshot import create_doc_from_snapshot as _cdfs
+
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return _cdfs(fb, snap, new_doc)
+        if self.gc:
+            raise RuntimeError("originDoc must not be garbage collected")
+        origin = Doc(gc=False)
+        apply_update(origin, self.encode_state_as_update(doc))
+        return _cdfs(origin, snap, new_doc)
+
+    def _delta_of_seg_snapshot(self, doc, seg, snap, prev, compute_ychange):
+        """Snapshot-scoped delta from the mirror columns: each run is cut
+        into element sub-ranges of uniform visibility under (sv, ds) of
+        both snapshots, so no struct pre-splitting is needed — the exact
+        twin of YText.js:936-1030 / types/ytext.py to_delta (the parity
+        test pins them op-for-op)."""
+        from bisect import bisect_right
+
+        from ..core import ContentEmbed, ContentFormat, ContentString, is_deleted
+        from ..ids import create_id
+        from ..types.ytext import update_current_attributes
+
+        if self.gc:
+            # compaction GC'd deleted runs' content: historical views are
+            # unrenderable, exactly like the reference's
+            # createDocFromSnapshot guard (Snapshot.js:165)
+            raise RuntimeError(
+                "snapshot-scoped to_delta requires engine gc=False"
+            )
+        m = self.mirrors[doc]
+        ops: list = []
+        cur: dict = {}
+        parts: list[str] = []
+        # per-snapshot, per-client sorted (start, end) edge tables: the
+        # row loop bisects instead of scanning the whole DeleteSet
+        edge_tables: dict[int, dict[int, tuple[list, list]]] = {}
+        for si, sn in enumerate((snap, prev)):
+            if sn is None:
+                continue
+            tab: dict[int, tuple[list, list]] = {}
+            for cl, items in sn.ds.clients.items():
+                tab[cl] = (
+                    [it.clock for it in items],
+                    [it.clock + it.len for it in items],
+                )
+            edge_tables[si] = tab
+
+        def pack_str():
+            if parts:
+                op = {"insert": from_u16("".join(parts))}
+                if cur:
+                    op["attributes"] = dict(cur)
+                ops.append(op)
+                parts.clear()
+
+        def vis(sn, client, clk):
+            # element-level twin of Snapshot.js:133-135 isVisible (the
+            # reference checks post-split item starts; elements subsume)
+            if sn is None:
+                return None
+            return (
+                client in sn.sv
+                and sn.sv.get(client, 0) > clk
+                and not is_deleted(sn.ds, create_id(client, clk))
+            )
+
+        rows, dels = self._order(doc, seg)
+        for r, dl in zip(rows, dels):
+            r = int(r)
+            if m.row_is_gc[r]:
+                continue  # GC'd runs carry no content; see gc caveat
+            client = m.client_of_slot[m.row_slot[r]]
+            clock = int(m.row_clock[r])
+            ln = int(m.row_len[r])
+            # visibility boundaries inside this run: sv bounds + ds edges
+            # (bisected — ds lists are sorted and disjoint)
+            cuts = {clock, clock + ln}
+            for si, sn in enumerate((snap, prev)):
+                if sn is None:
+                    continue
+                b = sn.sv.get(client, 0)
+                if clock < b < clock + ln:
+                    cuts.add(b)
+                starts_ends = edge_tables[si].get(client)
+                if starts_ends is None:
+                    continue
+                starts, ends = starts_ends
+                j = bisect_right(ends, clock)
+                while j < len(starts) and starts[j] < clock + ln:
+                    if clock < starts[j]:
+                        cuts.add(starts[j])
+                    if ends[j] < clock + ln:
+                        cuts.add(ends[j])
+                    j += 1
+            content = None
+            pts = sorted(cuts)
+            for a, b in zip(pts, pts[1:]):
+                v_now = vis(snap, client, a)
+                if snap is None:
+                    v_now = not dl  # plain visibility when only prev given
+                v_prev = vis(prev, client, a)
+                if not (v_now or (prev is not None and v_prev)):
+                    continue
+                if content is None:
+                    content = m.realized_content(r)
+                if isinstance(content, ContentString):
+                    cy = cur.get("ychange")
+                    if snap is not None and not v_now:
+                        if (
+                            cy is None
+                            or cy.get("user") != client
+                            or cy.get("state") != "removed"
+                        ):
+                            pack_str()
+                            cur["ychange"] = (
+                                compute_ychange("removed", create_id(client, a))
+                                if compute_ychange
+                                else {"type": "removed"}
+                            )
+                    elif prev is not None and not v_prev:
+                        if (
+                            cy is None
+                            or cy.get("user") != client
+                            or cy.get("state") != "added"
+                        ):
+                            pack_str()
+                            cur["ychange"] = (
+                                compute_ychange("added", create_id(client, a))
+                                if compute_ychange
+                                else {"type": "added"}
+                            )
+                    elif cy is not None:
+                        pack_str()
+                        cur.pop("ychange", None)
+                    parts.append(content.str[a - clock : b - clock])
+                elif isinstance(content, ContentEmbed):
+                    pack_str()
+                    op = {"insert": content.embed}
+                    if cur:
+                        op["attributes"] = dict(cur)
+                    ops.append(op)
+                elif isinstance(content, ContentFormat):
+                    if v_now:
+                        pack_str()
+                        update_current_attributes(cur, content)
+        pack_str()
+        return ops
 
     def _delta_of_seg(self, doc: int, seg: int) -> list:
         from ..core import ContentEmbed, ContentFormat, ContentString

@@ -311,6 +311,36 @@ class NativeMirror:
             int(c): int(s) for c, s in zip(clients, state) if s > 0
         }
 
+    def delete_set(self):
+        """The doc's derived DeleteSet straight from the core — a cheap
+        Snapshot capture (no shadow sync, no device I/O); the DocMirror
+        twin is columns.py delete_set()."""
+        from ..core import DeleteItem, DeleteSet
+
+        lib, h = self._lib, self._h
+        ds = DeleteSet()
+        nds = int(lib.ymx_ds_count(h))
+        if not nds:
+            return ds
+        ds_slot = np.empty(nds, np.int64)
+        ds_clock = np.empty(nds, np.int64)
+        ds_len = np.empty(nds, np.int64)
+        lib.ymx_ds(h, _p64(ds_slot), _p64(ds_clock), _p64(ds_len))
+        ns = int(lib.ymx_n_slots(h))
+        clients = np.empty(max(1, ns), np.int64)
+        lib.ymx_clients(h, _p64(clients))
+        by_client: dict[int, list[tuple[int, int]]] = {}
+        for s, c, ln in zip(
+            ds_slot.tolist(), ds_clock.tolist(), ds_len.tolist()
+        ):
+            by_client.setdefault(int(clients[s]), []).append((c, ln))
+        for cl, ranges in by_client.items():
+            ds.clients[cl] = [
+                DeleteItem(clock, ln)
+                for clock, ln in DocMirror._union_ranges(ranges)
+            ]
+        return ds
+
     def static_columns(self, start: int = 0) -> dict[str, np.ndarray]:
         lib, h = self._lib, self._h
         n = self.n_rows - start

@@ -189,11 +189,38 @@ class TpuProvider:
         self.flush()
         return self.engine.text(self.doc_id(guid))
 
-    def to_delta(self, guid: str) -> list:
+    def to_delta(
+        self,
+        guid: str,
+        snapshot=None,
+        prev_snapshot=None,
+        compute_ychange=None,
+    ) -> list:
         """Attributed rich-text delta of the room's root text (reference
-        YText.toDelta) — served from the mirror, no CPU replay."""
+        YText.toDelta) — served from the mirror, no CPU replay.  With
+        ``snapshot``/``prev_snapshot``, the point-in-time / two-snapshot
+        diff view with ychange attribution (YText.js:936-1030)."""
         self.flush()
-        return self.engine.to_delta(self.doc_id(guid))
+        return self.engine.to_delta(
+            self.doc_id(guid),
+            snapshot=snapshot,
+            prev_snapshot=prev_snapshot,
+            compute_ychange=compute_ychange,
+        )
+
+    def snapshot(self, guid: str):
+        """Capture the room's point-in-time Snapshot (SV + DS) without
+        demoting it off the device (reference Snapshot.js snapshot())."""
+        self.flush()
+        return self.engine.snapshot(self.doc_id(guid))
+
+    def create_doc_from_snapshot(self, guid: str, snap, new_doc=None):
+        """Rewind the room to ``snap`` as a standalone CPU Doc (reference
+        Snapshot.js:162-202); the device-resident room is untouched."""
+        self.flush()
+        return self.engine.create_doc_from_snapshot(
+            self.doc_id(guid), snap, new_doc
+        )
 
     def xml_string(self, guid: str) -> str:
         """XML serialization of the room's root fragment (reference
