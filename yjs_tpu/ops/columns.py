@@ -1484,7 +1484,6 @@ class DocMirror:
             self.client_of_slot[s]: st for s, st in enumerate(self.state) if st > 0
         }
 
-
     def encode_state_vector(self) -> bytes:
         from ..coding import DSEncoderV1
         from ..updates import write_state_vector

@@ -52,6 +52,8 @@ class TpuProvider:
         self._guid_of: dict[int, str] = {}
         self._next = 0
         self._dirty = False
+        # per-room server-side undo stacks (opt-in; see enable_undo)
+        self._undo: dict[str, object] = {}
 
     # -- doc management -----------------------------------------------------
 
@@ -100,9 +102,84 @@ class TpuProvider:
 
     # -- update plumbing ----------------------------------------------------
 
-    def receive_update(self, guid: str, update: bytes, v2: bool = False) -> None:
+    def receive_update(
+        self, guid: str, update: bytes, v2: bool = False,
+        undoable: bool = False,
+    ) -> None:
+        """Queue one room update.  ``undoable=True`` marks it for the
+        room's undo stack when :meth:`enable_undo` is active (the server
+        decides which origins' edits count — reference trackedOrigins,
+        UndoManager.js:19-41)."""
         self.engine.queue_update(self.doc_id(guid), update, v2=v2)
         self._dirty = True
+        ru = self._undo.get(guid)
+        if ru is not None:
+            ru.apply_update(update, tracked=undoable, v2=v2)
+
+    # -- server-side undo ---------------------------------------------------
+
+    def enable_undo(
+        self,
+        guid: str,
+        scopes=None,
+        capture_timeout: float = 500,
+        delete_filter=None,
+    ):
+        """Attach a server-side undo/redo stack to one room (reference
+        UndoManager semantics, run against an opt-in CPU replica — see
+        utils/server_undo.py for the design rationale).  The room itself
+        stays device-resident."""
+        from .utils.server_undo import RoomUndo
+
+        if guid in self._undo:
+            if (
+                scopes is not None
+                or capture_timeout != 500
+                or delete_filter is not None
+            ):
+                raise ValueError(
+                    f"undo already enabled for {guid!r} with different "
+                    "settings; call clear() on the existing stack instead"
+                )
+            return self._undo[guid]
+        self.flush()
+        i = self.doc_id(guid)
+        if scopes is None:
+            scopes = (("text", self.engine.root_name),)
+        ru = RoomUndo(
+            self.engine.encode_state_as_update(i),
+            scopes=scopes,
+            capture_timeout=capture_timeout,
+            delete_filter=delete_filter,
+        )
+        self._undo[guid] = ru
+        return ru
+
+    def undo(self, guid: str) -> bytes | None:
+        """Revert the room's last undoable change.  The reverting update
+        is applied to the device-resident room through the normal flush
+        path and returned for broadcast to peers (None = nothing to
+        undo)."""
+        ru = self._undo.get(guid)
+        if ru is None:
+            raise ValueError(f"undo not enabled for room {guid!r}")
+        u = ru.undo()
+        if u is not None:
+            self.engine.queue_update(self.doc_id(guid), u)
+            self._dirty = True
+            self.flush()
+        return u
+
+    def redo(self, guid: str) -> bytes | None:
+        ru = self._undo.get(guid)
+        if ru is None:
+            raise ValueError(f"undo not enabled for room {guid!r}")
+        u = ru.redo()
+        if u is not None:
+            self.engine.queue_update(self.doc_id(guid), u)
+            self._dirty = True
+            self.flush()
+        return u
 
     def flush(self) -> None:
         """Run one batched device integration step over all pending docs.
