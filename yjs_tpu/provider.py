@@ -54,6 +54,7 @@ class TpuProvider:
         self._dirty = False
         # per-room server-side undo stacks (opt-in; see enable_undo)
         self._undo: dict[str, object] = {}
+        self._undo_settings: dict[str, tuple] = {}
 
     # -- doc management -----------------------------------------------------
 
@@ -124,45 +125,58 @@ class TpuProvider:
         scopes=None,
         capture_timeout: float = 500,
         delete_filter=None,
-    ):
+    ) -> "RoomUndoHandle":
         """Attach a server-side undo/redo stack to one room (reference
         UndoManager semantics, run against an opt-in CPU replica — see
         utils/server_undo.py for the design rationale).  The room itself
-        stays device-resident."""
+        stays device-resident.  Idempotent for identical settings; a
+        repeat call with DIFFERENT settings raises."""
         from .utils.server_undo import RoomUndo
 
+        norm_scopes = (
+            tuple(scopes) if scopes is not None
+            else (("text", self.engine.root_name),)
+        )
+        settings = (norm_scopes, capture_timeout, delete_filter)
         if guid in self._undo:
-            if (
-                scopes is not None
-                or capture_timeout != 500
-                or delete_filter is not None
-            ):
+            if self._undo_settings[guid] != settings:
                 raise ValueError(
                     f"undo already enabled for {guid!r} with different "
-                    "settings; call clear() on the existing stack instead"
+                    "settings; disable_undo() first to reconfigure"
                 )
-            return self._undo[guid]
+            return RoomUndoHandle(self, guid)
         self.flush()
         i = self.doc_id(guid)
-        if scopes is None:
-            scopes = (("text", self.engine.root_name),)
         ru = RoomUndo(
             self.engine.encode_state_as_update(i),
-            scopes=scopes,
+            scopes=norm_scopes,
             capture_timeout=capture_timeout,
             delete_filter=delete_filter,
         )
         self._undo[guid] = ru
+        self._undo_settings[guid] = settings
+        return RoomUndoHandle(self, guid)
+
+    def disable_undo(self, guid: str) -> None:
+        """Detach and free the room's undo replica (the room itself is
+        unaffected).  No-op if undo was never enabled."""
+        self._undo.pop(guid, None)
+        self._undo_settings.pop(guid, None)
+
+    def _room_undo(self, guid: str):
+        ru = self._undo.get(guid)
+        if ru is None:
+            raise ValueError(f"undo not enabled for room {guid!r}")
         return ru
 
     def undo(self, guid: str) -> bytes | None:
         """Revert the room's last undoable change.  The reverting update
         is applied to the device-resident room through the normal flush
-        path and returned for broadcast to peers (None = nothing to
+        path — peers receive it via the ``on_update`` broadcast seam like
+        any other change; do NOT also send the returned bytes.  The
+        return value reports what was reverted (None = nothing to
         undo)."""
-        ru = self._undo.get(guid)
-        if ru is None:
-            raise ValueError(f"undo not enabled for room {guid!r}")
+        ru = self._room_undo(guid)
         u = ru.undo()
         if u is not None:
             self.engine.queue_update(self.doc_id(guid), u)
@@ -171,9 +185,7 @@ class TpuProvider:
         return u
 
     def redo(self, guid: str) -> bytes | None:
-        ru = self._undo.get(guid)
-        if ru is None:
-            raise ValueError(f"undo not enabled for room {guid!r}")
+        ru = self._room_undo(guid)
         u = ru.redo()
         if u is not None:
             self.engine.queue_update(self.doc_id(guid), u)
@@ -330,3 +342,43 @@ class TpuProvider:
     def metrics(self) -> dict | None:
         """Host per-phase timers + batch stats of the last flush."""
         return self.engine.last_flush_metrics
+
+
+class RoomUndoHandle:
+    """Guid-bound view of one room's server-side undo stack.
+
+    All reverting operations route through the provider so the
+    device-resident room and the undo replica can never diverge — the
+    raw RoomUndo's own undo()/redo() would revert only the replica."""
+
+    __slots__ = ("_provider", "_guid")
+
+    def __init__(self, provider: TpuProvider, guid: str):
+        self._provider = provider
+        self._guid = guid
+
+    def undo(self) -> bytes | None:
+        return self._provider.undo(self._guid)
+
+    def redo(self) -> bytes | None:
+        return self._provider.redo(self._guid)
+
+    @property
+    def can_undo(self) -> bool:
+        return self._provider._room_undo(self._guid).can_undo
+
+    @property
+    def can_redo(self) -> bool:
+        return self._provider._room_undo(self._guid).can_redo
+
+    def stop_capturing(self) -> None:
+        self._provider._room_undo(self._guid).stop_capturing()
+
+    def clear(self) -> None:
+        self._provider._room_undo(self._guid).clear()
+
+    @property
+    def manager(self):
+        """The underlying reference UndoManager (event subscription —
+        stack-item-added / stack-item-popped)."""
+        return self._provider._room_undo(self._guid).manager
