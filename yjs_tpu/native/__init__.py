@@ -185,6 +185,18 @@ def load():
         lib._has_plancore = True
     except AttributeError:
         lib._has_plancore = False
+    # per-feature probes: symbols added after r3 degrade gracefully on a
+    # stale binary-only .so instead of disabling the whole planner
+    try:
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        pu8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.ymx_compact_self.restype = ctypes.c_int64
+        lib.ymx_compact_self.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, p32, pu8, p32, ctypes.c_int64,
+        ]
+        lib._has_compact_self = True
+    except AttributeError:
+        lib._has_compact_self = False
     _lib = lib
     return _lib
 

@@ -2806,4 +2806,28 @@ int64_t ymx_compact(void* h, const int32_t* right_link,
                                           new_heads_cap);
 }
 
+// compaction from the mirror's OWN list/deleted state — the flush
+// invariant keeps these equal to the device arrays, so no device
+// read-back is needed to decide merges (the r3 readback-rebuild cycle
+// was the 100k-doc scaling liability); the device gets the rebuilt
+// arrays in one write-only scatter
+int64_t ymx_compact_self(void* h, int gc, int32_t* new_right,
+                         uint8_t* new_deleted, int32_t* new_heads,
+                         int64_t new_heads_cap) {
+  Mirror* m = static_cast<Mirror*>(h);
+  int64_t n = m->n_rows();
+  int64_t nseg = m->n_segs();
+  std::vector<int32_t> right((size_t)std::max<int64_t>(1, n));
+  std::vector<uint8_t> del((size_t)std::max<int64_t>(1, n));
+  std::vector<int32_t> heads((size_t)std::max<int64_t>(1, nseg));
+  for (int64_t i = 0; i < n; i++) {
+    right[(size_t)i] = (int32_t)m->list_next[(size_t)i];
+    del[(size_t)i] = m->r_host_deleted[(size_t)i];
+  }
+  for (int64_t s = 0; s < nseg; s++)
+    heads[(size_t)s] = (int32_t)m->head_of_seg[(size_t)s];
+  return m->compact(right.data(), del.data(), heads.data(), nseg, gc,
+                    new_right, new_deleted, new_heads, new_heads_cap);
+}
+
 }  // extern "C"

@@ -1277,6 +1277,27 @@ class DocMirror:
 
     # -- compaction ---------------------------------------------------------
 
+    def rebuild_compacted_self(self, gc: bool):
+        """Compact from the mirror's own list/deleted state — no device
+        read-back needed (the flush invariant keeps ``list_next`` /
+        ``_host_deleted_rows`` / ``head_of_seg`` equal to the device
+        arrays; the YTPU_EXPORT_DEVICE test path pins that equality)."""
+        n = max(1, self.n_rows)
+        right = np.full(n, NULL, np.int32)
+        if self.n_rows:
+            right[: self.n_rows] = np.asarray(
+                self.list_next[: self.n_rows], np.int32
+            )
+        deleted = np.zeros(n, bool)
+        for r in self._host_deleted_rows:
+            deleted[r] = True
+        heads = (
+            np.asarray(self.head_of_seg, np.int32)
+            if self.n_segs
+            else np.full(1, NULL, np.int32)
+        )
+        return self.rebuild_compacted(right, deleted, heads, gc)
+
     def rebuild_compacted(self, right_link, deleted, head_of_seg, gc: bool):
         """Merge adjacent runs and GC deleted payloads, renumbering rows.
 

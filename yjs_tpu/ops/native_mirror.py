@@ -358,6 +358,43 @@ class NativeMirror:
 
     # -- compaction ---------------------------------------------------------
 
+    def rebuild_compacted_self(self, gc: bool):
+        """Compact from the mirror's own list state — no device read-back
+        (the flush invariant keeps mirror links == device links).  On a
+        stale binary-only .so without ymx_compact_self, the same inputs
+        are synthesized host-side from the core's link/head exports and
+        fed to the original ymx_compact — still zero device traffic."""
+        lib, h = self._lib, self._h
+        n = self.n_rows
+        nseg = self.n_segs
+        new_right = np.full(max(1, n), NULL, np.int32)
+        new_del = np.zeros(max(1, n), np.uint8)
+        new_heads = np.full(max(1, nseg), NULL, np.int32)
+        if getattr(lib, "_has_compact_self", False):
+            n_new = lib.ymx_compact_self(
+                h, int(bool(gc)), _p32(new_right),
+                new_del.ctypes.data_as(_u8p), _p32(new_heads),
+                len(new_heads),
+            )
+            self._realized.clear()
+            return (
+                new_right[:n_new],
+                new_del[:n_new].astype(bool),
+                new_heads,
+            )
+        links = np.full(max(1, n), NULL, np.int64)
+        if n:
+            lib.ymx_links(h, _p64(links))
+        heads = np.full(max(1, nseg), NULL, np.int64)
+        if nseg:
+            lib.ymx_heads(h, _p64(heads))
+        deleted = np.zeros(max(1, n), bool)
+        for r in self._host_deleted_rows:
+            deleted[r] = True
+        return self.rebuild_compacted(
+            links.astype(np.int32), deleted, heads.astype(np.int32), gc
+        )
+
     def rebuild_compacted(self, right_link, deleted, head_of_seg, gc: bool):
         lib, h = self._lib, self._h
         n = self.n_rows
