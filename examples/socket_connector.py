@@ -1,0 +1,155 @@
+"""SocketConnector: a TCP transport on the AbstractConnector base.
+
+A second transport example beyond ``server_demo.py``'s in-process
+provider: each peer binds one ``Y.Doc`` to a length-prefixed TCP framing
+of the y-protocols sync messages (step 1 / step 2 / incremental update —
+``yjs_tpu.sync.protocol``), so the wire bytes are exactly what a JS
+``y-websocket`` peer would exchange.
+
+Run in two terminals (the first becomes the listener):
+
+    python examples/socket_connector.py serve 47800
+    python examples/socket_connector.py join  47800
+
+Both processes make concurrent edits and print the converged text.
+Reference seams: src/utils/AbstractConnector.js:16-26 (the base),
+y-protocols/sync.js (the message flow the protocol module mirrors).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yjs_tpu as Y
+from yjs_tpu.lib0.decoding import Decoder
+from yjs_tpu.lib0.encoding import Encoder
+from yjs_tpu.sync import protocol
+from yjs_tpu.utils.abstract_connector import AbstractConnector
+
+
+class SocketConnector(AbstractConnector):
+    """Bind one doc to one TCP peer: handshake on connect, then stream
+    local transactions as incremental update frames.
+
+    The Doc is NOT thread-safe; the receive thread applies remote
+    messages under ``self.lock``, and local edits from other threads
+    must take the same lock (see ``_demo``)."""
+
+    def __init__(self, ydoc: Y.Doc, sock: socket.socket, awareness=None):
+        super().__init__(ydoc, awareness)
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        #: guards every doc mutation (remote applies AND local edits)
+        self.lock = threading.RLock()
+        self._closed = False
+        ydoc.on("update", self._on_local_update)
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+
+    # -- framing ------------------------------------------------------------
+
+    def _send(self, payload: bytes) -> None:
+        with self._send_lock:
+            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+    def _recv(self) -> bytes | None:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = self._sock.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack("<I", hdr)
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- sync flow ----------------------------------------------------------
+
+    def connect(self) -> None:
+        """Send sync step 1 and start consuming the peer's messages."""
+        enc = Encoder()
+        protocol.write_sync_step1(enc, self.doc)
+        self._send(enc.to_bytes())
+        self._rx.start()
+
+    def _on_local_update(self, update: bytes, origin, doc) -> None:
+        if origin is self or self._closed:
+            return  # don't echo remote updates back
+        enc = Encoder()
+        protocol.write_update(enc, update)
+        try:
+            self._send(enc.to_bytes())
+        except OSError:
+            if not self._closed:  # a racing close() is expected noise
+                raise
+
+    def _recv_loop(self) -> None:
+        try:
+            while not self._closed:
+                payload = self._recv()
+                if payload is None:
+                    break
+                dec = Decoder(payload)
+                enc = Encoder()
+                # replies (our step 2) go straight back over the socket;
+                # the doc mutation happens under the shared doc lock
+                with self.lock:
+                    protocol.read_sync_message(dec, enc, self.doc, self)
+                if enc.to_bytes():
+                    self._send(enc.to_bytes())
+        except (OSError, ValueError):
+            pass  # peer vanished / malformed frame: fall through to close
+        finally:
+            self.emit("close", [])
+
+    def close(self) -> None:
+        self._closed = True
+        self.doc.off("update", self._on_local_update)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def _demo(role: str, port: int) -> None:
+    doc = Y.Doc(gc=False)
+    doc.client_id = 1 if role == "serve" else 2
+    text = doc.get_text("text")
+    if role == "serve":
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        text.insert(0, "listener says hi. ")
+        conn, _ = srv.accept()
+    else:
+        conn = socket.create_connection(("127.0.0.1", port))
+        text.insert(0, "joiner says hi. ")
+
+    connector = SocketConnector(doc, conn)
+    connector.connect()
+
+    import time
+
+    time.sleep(1.0)  # let the handshake settle
+    with connector.lock:  # local edits share the doc lock with the rx thread
+        text.insert(len(text.to_string()), f"[{role} concurrent edit]")
+    time.sleep(1.0)
+    print(f"{role}: {text.to_string()!r}")
+    print(f"{role}: sv={Y.encode_state_vector(doc).hex()}")
+    connector.close()
+
+
+if __name__ == "__main__":
+    _demo(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 47800)

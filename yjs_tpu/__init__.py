@@ -97,6 +97,7 @@ from .updates import (  # noqa: F401
     read_update,
     read_update_v2,
 )
+from .utils.abstract_connector import AbstractConnector  # noqa: F401
 from .utils.permanent_user_data import PermanentUserData  # noqa: F401
 from .utils.relative_position import (  # noqa: F401
     AbsolutePosition,

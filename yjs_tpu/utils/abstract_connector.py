@@ -1,0 +1,25 @@
+"""AbstractConnector: the interchangeable-connector contract
+(reference src/utils/AbstractConnector.js:16-26).
+
+All connectors hold the doc they bind and an (optional) awareness
+instance and speak through the Observable event surface; like the
+reference, this is typing/contract information more than machinery —
+``examples/socket_connector.py`` shows a real transport built on it.
+"""
+
+from __future__ import annotations
+
+from ..lib0.observable import Observable
+
+
+class AbstractConnector(Observable):
+    """Base class all connectors implement to stay interchangeable.
+
+    Note (mirroring the reference): this interface is experimental and
+    inheriting it is optional — it serves as the contract's shape.
+    """
+
+    def __init__(self, ydoc, awareness=None):
+        super().__init__()
+        self.doc = ydoc
+        self.awareness = awareness
