@@ -45,11 +45,19 @@ class SocketConnector(AbstractConnector):
         super().__init__(ydoc, awareness)
         self._sock = sock
         self._send_lock = threading.Lock()
-        #: guards every doc mutation (remote applies AND local edits)
+        #: guards every doc access (remote applies, local edits, reads)
         self.lock = threading.RLock()
         self._closed = False
+        # outbound frames ride a queue drained by a writer thread: the
+        # update handler fires while the editor holds self.lock, and
+        # blocking in sendall there would deadlock two back-pressured
+        # peers whose rx threads both wait on that lock
+        import queue
+
+        self._outbox: "queue.Queue[bytes | None]" = queue.Queue()
         ydoc.on("update", self._on_local_update)
         self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._tx = threading.Thread(target=self._send_loop, daemon=True)
 
     # -- framing ------------------------------------------------------------
 
@@ -76,22 +84,29 @@ class SocketConnector(AbstractConnector):
     # -- sync flow ----------------------------------------------------------
 
     def connect(self) -> None:
-        """Send sync step 1 and start consuming the peer's messages."""
+        """Send sync step 1 and start the reader/writer threads."""
         enc = Encoder()
         protocol.write_sync_step1(enc, self.doc)
-        self._send(enc.to_bytes())
+        self._outbox.put(enc.to_bytes())
         self._rx.start()
+        self._tx.start()
 
     def _on_local_update(self, update: bytes, origin, doc) -> None:
         if origin is self or self._closed:
             return  # don't echo remote updates back
         enc = Encoder()
         protocol.write_update(enc, update)
+        self._outbox.put(enc.to_bytes())  # never blocks the editor
+
+    def _send_loop(self) -> None:
         try:
-            self._send(enc.to_bytes())
+            while True:
+                payload = self._outbox.get()
+                if payload is None:
+                    break
+                self._send(payload)
         except OSError:
-            if not self._closed:  # a racing close() is expected noise
-                raise
+            pass  # peer vanished: rx loop emits the close event
 
     def _recv_loop(self) -> None:
         try:
@@ -101,12 +116,13 @@ class SocketConnector(AbstractConnector):
                     break
                 dec = Decoder(payload)
                 enc = Encoder()
-                # replies (our step 2) go straight back over the socket;
-                # the doc mutation happens under the shared doc lock
+                # replies (our step 2) ride the outbox too; the doc
+                # mutation happens under the shared doc lock
                 with self.lock:
                     protocol.read_sync_message(dec, enc, self.doc, self)
-                if enc.to_bytes():
-                    self._send(enc.to_bytes())
+                reply = enc.to_bytes()
+                if reply:
+                    self._outbox.put(reply)
         except (OSError, ValueError):
             pass  # peer vanished / malformed frame: fall through to close
         finally:
@@ -115,6 +131,7 @@ class SocketConnector(AbstractConnector):
     def close(self) -> None:
         self._closed = True
         self.doc.off("update", self._on_local_update)
+        self._outbox.put(None)  # unblock the writer thread
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -143,11 +160,12 @@ def _demo(role: str, port: int) -> None:
     import time
 
     time.sleep(1.0)  # let the handshake settle
-    with connector.lock:  # local edits share the doc lock with the rx thread
+    with connector.lock:  # doc access shares the lock with the rx thread
         text.insert(len(text.to_string()), f"[{role} concurrent edit]")
     time.sleep(1.0)
-    print(f"{role}: {text.to_string()!r}")
-    print(f"{role}: sv={Y.encode_state_vector(doc).hex()}")
+    with connector.lock:
+        print(f"{role}: {text.to_string()!r}")
+        print(f"{role}: sv={Y.encode_state_vector(doc).hex()}")
     connector.close()
 
 

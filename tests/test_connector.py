@@ -39,18 +39,22 @@ def test_socket_connector_two_peer_convergence():
     cb = SocketConnector(db, b_sock)
     ca.connect()
     cb.connect()
+    def texts():
+        # doc reads share each connector's lock with its rx thread
+        with ca.lock:
+            ta = da.get_text("text").to_string()
+        with cb.lock:
+            tb = db.get_text("text").to_string()
+        return ta, tb
+
     deadline = time.time() + 10
     while time.time() < deadline:
-        if (
-            da.get_text("text").to_string()
-            == db.get_text("text").to_string()
-            and da.get_text("text").to_string() != ""
-        ):
+        ta, tb = texts()
+        if ta == tb and ta != "":
             break
         time.sleep(0.05)
-    assert (
-        da.get_text("text").to_string() == db.get_text("text").to_string()
-    ), "handshake did not converge"
+    ta, tb = texts()
+    assert ta == tb, "handshake did not converge"
 
     # live incremental updates after the handshake (doc mutations share
     # the connector's doc lock with its receive thread)
@@ -58,18 +62,23 @@ def test_socket_connector_two_peer_convergence():
         da.get_text("text").insert(0, "[live-A]")
     with cb.lock:
         db.get_map("meta").set("k", 7)
+    def maps():
+        with ca.lock:
+            ma = da.get_map("meta").to_json()
+        with cb.lock:
+            mb = db.get_map("meta").to_json()
+        return ma, mb
+
     deadline = time.time() + 10
     while time.time() < deadline:
-        if (
-            da.get_text("text").to_string()
-            == db.get_text("text").to_string()
-            and da.get_map("meta").to_json() == db.get_map("meta").to_json()
-        ):
+        ta, tb = texts()
+        ma, mb = maps()
+        if ta == tb and ma == mb:
             break
         time.sleep(0.05)
-    assert da.get_text("text").to_string() == db.get_text("text").to_string()
-    assert da.get_map("meta").to_json() == db.get_map("meta").to_json() == {
-        "k": 7
-    }
+    ta, tb = texts()
+    ma, mb = maps()
+    assert ta == tb
+    assert ma == mb == {"k": 7}
     ca.close()
     cb.close()

@@ -305,3 +305,45 @@ class TestNativeEncode:
         d.get_text("text").insert(0, "plain")
         refs, ds = python_decode(Y.encode_state_as_update(d))
         assert refs[3][0].length == 5
+
+
+def test_wide_key_dictionary_stays_native():
+    """>4096 distinct map keys ride the native V2 scan without demotion
+    (the old fixed key-table cap silently demoted wide docs; ADVICE r3)."""
+    import yjs_tpu as Y
+    from yjs_tpu.ops import BatchEngine
+
+    d = Y.Doc(gc=False)
+    m = d.get_map("meta")
+    for i in range(4200):
+        m.set(f"key{i}", i)
+    eng = BatchEngine(1, root_name="meta")
+    eng.queue_update(0, Y.encode_state_as_update_v2(d), v2=True)
+    eng.flush()
+    assert eng.demotions == []
+    assert eng.map_json(0, "meta") == m.to_json()
+
+
+def test_malformed_utf8_matches_python_error():
+    """Adversarial bytes with invalid UTF-8 continuations must raise the
+    same error the Python decoder raises — not silently miscount on the
+    native path (ADVICE r3: continuation-byte validation)."""
+    import pytest
+
+    import yjs_tpu as Y
+    from yjs_tpu.ops import BatchEngine
+
+    base = Y.Doc(gc=False)
+    base.get_text("text").insert(0, "AAAA")
+    u = bytearray(Y.encode_state_as_update(base))
+    pos = bytes(u).find(b"AAAA")
+    u[pos] = 0xE2   # 3-byte lead ...
+    u[pos + 1] = 0x28  # ... with an invalid continuation byte
+    with pytest.raises(Exception) as py_err:
+        ref = Y.Doc(gc=False)
+        Y.apply_update(ref, bytes(u))
+    eng = BatchEngine(1)
+    eng.queue_update(0, bytes(u))
+    with pytest.raises(Exception) as nat_err:
+        eng.flush()
+    assert type(nat_err.value) is type(py_err.value)

@@ -7,6 +7,7 @@
 #pragma once
 #include <cstdint>
 #include <cstddef>
+#include <vector>
 
 namespace ytpu_wire {
 
@@ -76,15 +77,29 @@ struct Reader {
     skip(n);
   }
 
-  // UTF-16 code-unit count of a utf8 range (JS string .length semantics)
-  uint64_t utf16_len(uint64_t ofs, uint64_t blen) const {
+  // UTF-16 code-unit count of a utf8 range (JS string .length
+  // semantics).  Malformed sequences — bad lead byte, missing/invalid
+  // continuation bytes (must be 0x80-0xBF), truncation — set `fail`, so
+  // adversarial bytes take the demote-to-Python path instead of
+  // silently miscounting (ADVICE r3: the Python decoder raises here)
+  uint64_t utf16_len(uint64_t ofs, uint64_t blen) {
     uint64_t units = 0;
-    for (uint64_t i = ofs; i < ofs + blen && i < len; ) {
+    uint64_t end = ofs + blen;
+    if (end > len) { fail = true; return 0; }
+    for (uint64_t i = ofs; i < end; ) {
       uint8_t b = buf[i];
-      if (b < 0x80) { units += 1; i += 1; }
-      else if (b < 0xE0) { units += 1; i += 2; }
-      else if (b < 0xF0) { units += 1; i += 3; }
-      else { units += 2; i += 4; }
+      uint64_t n;
+      if (b < 0x80) { n = 1; units += 1; }
+      else if (b < 0xC2) { fail = true; return 0; }  // continuation/overlong lead
+      else if (b < 0xE0) { n = 2; units += 1; }
+      else if (b < 0xF0) { n = 3; units += 1; }
+      else if (b < 0xF5) { n = 4; units += 2; }
+      else { fail = true; return 0; }                // > U+10FFFF lead
+      if (i + n > end) { fail = true; return 0; }    // truncated sequence
+      for (uint64_t j = 1; j < n; j++) {
+        if ((buf[i + j] & 0xC0) != 0x80) { fail = true; return 0; }
+      }
+      i += n;
     }
     return units;
   }
@@ -205,7 +220,9 @@ struct StringDec {  // one UTF-8 arena + UintOptRle of UTF-16 lengths
     cursor = arena_ofs;
   }
 
-  // consume one string; returns absolute (ofs, end) byte range of its UTF-8
+  // consume one string; returns absolute (ofs, end) byte range of its
+  // UTF-8.  Continuation bytes are validated (0x80-0xBF) so malformed
+  // arenas fail the scan (-> demote-to-Python) instead of miscounting
   void read(int64_t* ofs, int64_t* end) {
     int64_t units = lens.read();
     *ofs = (int64_t)cursor;
@@ -213,10 +230,19 @@ struct StringDec {  // one UTF-8 arena + UintOptRle of UTF-16 lengths
     int64_t got = 0;
     while (got < units && i < arena_end) {
       uint8_t b = buf[i];
-      if (b < 0x80) { got += 1; i += 1; }
-      else if (b < 0xE0) { got += 1; i += 2; }
-      else if (b < 0xF0) { got += 1; i += 3; }
-      else { got += 2; i += 4; }
+      uint64_t n;
+      if (b < 0x80) { n = 1; got += 1; }
+      else if (b < 0xC2) { lens.r.fail = true; break; }
+      else if (b < 0xE0) { n = 2; got += 1; }
+      else if (b < 0xF0) { n = 3; got += 1; }
+      else if (b < 0xF5) { n = 4; got += 2; }
+      else { lens.r.fail = true; break; }
+      if (i + n > arena_end) { lens.r.fail = true; break; }
+      for (uint64_t j = 1; j < n; j++) {
+        if ((buf[i + j] & 0xC0) != 0x80) { lens.r.fail = true; break; }
+      }
+      if (lens.r.fail) break;
+      i += n;
     }
     if (got != units || i > arena_end) lens.r.fail = true;
     cursor = i;
@@ -237,11 +263,11 @@ struct V2Streams {
   UintOptRle type_ref;
   UintOptRle len;
   Reader rest;  // counts, clocks, DS section, rest-stream contents
-  // read_key cache: ranges of previously seen keys (parent_sub dictionary)
-  static constexpr int kMaxKeys = 4096;
-  int64_t key_ofs[kMaxKeys], key_end[kMaxKeys];
-  int n_keys = 0;
-  bool fail = false;
+  // read_key cache: ranges of previously seen keys (parent_sub
+  // dictionary) — grows without bound like the reference's JS array
+  // (UpdateDecoder.js:370-393); the old 4096-entry cap silently demoted
+  // wide-key docs to the CPU core (ADVICE r3)
+  std::vector<int64_t> key_ofs, key_end;
 
   bool init(const uint8_t* buf, uint64_t blen) {
     Reader r{buf, blen, 0, false};
@@ -267,14 +293,18 @@ struct V2Streams {
 
   void read_key(int64_t* ofs, int64_t* end) {  // UpdateDecoder.js:382-391
     int64_t kc = key_clock.read();
-    if (kc < n_keys) { *ofs = key_ofs[kc]; *end = key_end[kc]; return; }
+    if (kc >= 0 && (size_t)kc < key_ofs.size()) {
+      *ofs = key_ofs[(size_t)kc];
+      *end = key_end[(size_t)kc];
+      return;
+    }
     str.read(ofs, end);
-    if (n_keys < kMaxKeys) { key_ofs[n_keys] = *ofs; key_end[n_keys] = *end; n_keys++; }
-    else fail = true;
+    key_ofs.push_back(*ofs);
+    key_end.push_back(*end);
   }
 
   bool any_fail() {
-    return fail || key_clock.r.fail || client.r.fail || left_clock.r.fail ||
+    return key_clock.r.fail || client.r.fail || left_clock.r.fail ||
            right_clock.r.fail || info.r.fail || str.failed() ||
            parent_info.r.fail || type_ref.r.fail || len.r.fail || rest.fail;
   }

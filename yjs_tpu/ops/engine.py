@@ -264,7 +264,15 @@ class BatchEngine:
         ``{"path", "delta", "keys"}`` — path[0] is the root type name,
         deeper elements are map keys / list indices (reference
         YEvent.path + YEvent.changes).  Demoted docs deliver the same
-        shape from the CPU core's transactions."""
+        shape from the CPU core's transactions.
+
+        Path note (deliberate divergence): numeric list positions in
+        ``path`` are COUNTABLE-LENGTH indices — what ``get(index)``
+        addresses — not the reference getPathTo's undeleted-item counts
+        (YEvent.js:207-228), which shift with run-merge state.  Code
+        comparing paths against upstream Yjs event paths may see
+        different numeric indices for list children (see
+        ops/events.py _path_of)."""
         self._event_listeners.setdefault(doc, []).append(callback)
         fb = self.fallback.get(doc)
         if fb is not None:

@@ -85,7 +85,12 @@ class TpuProvider:
         ``{"path", "delta", "keys"}`` computed from each flush's step plan
         (reference observe/observeDeep + YEvent.changes) — the server-side
         "what changed in room X" seam without replaying into a CPU doc.
-        Returns an unsubscribe callable."""
+        Returns an unsubscribe callable.
+
+        Numeric list positions in ``path`` are merge-invariant
+        countable-length indices (what ``get(index)`` addresses), NOT the
+        reference getPathTo's undeleted-item counts — see
+        BatchEngine.observe for the full divergence note."""
         prefix = list(path)
 
         def bridge(doc, events, g=guid):
