@@ -5,6 +5,7 @@ keep-flags on the undo site cause benign divergence in GC state.
 """
 
 import yjs_tpu as Y
+from yjs_tpu.core import ContentType, Item
 from helpers import init
 
 
@@ -212,3 +213,47 @@ def test_track_class():
 # is NOT in v13.4.9 — popStackItem pops exactly one stack item regardless of
 # whether a change was performed (reference UndoManager.js:62,121), so that
 # scenario is intentionally not ported.
+
+
+def test_type_scope(rng):
+    """Scope filtering across nested types (reference undo-redo.tests.js
+    testTypeScope)."""
+    result = init(rng, users=3)
+    array0 = result["array0"]
+    text0 = Y.YText()
+    text1 = Y.YText()
+    array0.insert(0, [text0, text1])
+    um = Y.UndoManager(text0)
+    um_both = Y.UndoManager([text0, text1])
+    text1.insert(0, "abc")
+    assert len(um.undo_stack) == 0
+    assert len(um_both.undo_stack) == 1
+    assert text1.to_string() == "abc"
+    um.undo()
+    assert text1.to_string() == "abc"
+    um_both.undo()
+    assert text1.to_string() == ""
+
+
+def test_undo_delete_filter(rng):
+    """delete_filter keeps non-empty nested maps alive through undo
+    (reference undo-redo.tests.js testUndoDeleteFilter)."""
+    from yjs_tpu.core import ContentType, Item
+
+    result = init(rng, users=3)
+    array0 = result["array0"]
+
+    def keep_filter(item):
+        return not isinstance(item, Item) or (
+            isinstance(item.content, ContentType)
+            and len(item.content.type._map) == 0
+        )
+
+    um = Y.UndoManager(array0, delete_filter=keep_filter)
+    map0 = Y.YMap()
+    map0.set("hi", 1)
+    map1 = Y.YMap()
+    array0.insert(0, [map0, map1])
+    um.undo()
+    assert array0.length == 1
+    assert len(list(array0.get(0).keys())) == 1

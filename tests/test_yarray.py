@@ -177,3 +177,162 @@ ARRAY_MODS = [_insert_generic, _insert_type_array, _insert_text, _delete_generic
 @pytest.mark.parametrize("iterations", [6, 40, 120])
 def test_repeat_random_array_ops(rng, iterations):
     apply_random_tests(rng, ARRAY_MODS, iterations)
+
+
+def test_slice():
+    """(reference y-array.tests.js testSlice)."""
+    doc = Y.Doc()
+    arr = doc.get_array("array")
+    arr.insert(0, [1, 2, 3])
+    assert arr.slice(0) == [1, 2, 3]
+    assert arr.slice(1) == [2, 3]
+    assert arr.slice(0, -1) == [1, 2]
+    arr.insert(0, [0])
+    assert arr.slice(0) == [0, 1, 2, 3]
+    assert arr.slice(0, 2) == [0, 1]
+
+
+def test_concurrent_insert_delete_with_three_conflicts(rng):
+    """(reference y-array.tests.js
+    testConcurrentInsertDeleteWithThreeConflicts)."""
+    result = init(rng, users=3)
+    array0, array1, array2 = (
+        result["array0"], result["array1"], result["array2"]
+    )
+    array0.insert(0, ["x", "y", "z"])
+    result["testConnector"].flush_all_messages()
+    array0.insert(1, [0])
+    array1.delete(0, 1)
+    array1.delete(1, 1)
+    array2.insert(1, [2])
+    compare(result["users"])
+
+
+def test_deletions_in_late_sync(rng):
+    """(reference y-array.tests.js testDeletionsInLateSync)."""
+    result = init(rng, users=2)
+    array0, array1 = result["array0"], result["array1"]
+    array0.insert(0, ["x", "y"])
+    result["testConnector"].flush_all_messages()
+    result["users"][1].disconnect()
+    array1.delete(1, 1)
+    array0.delete(0, 2)
+    result["users"][1].connect()
+    compare(result["users"])
+
+
+def test_insert_then_merge_delete_on_sync(rng):
+    """(reference y-array.tests.js testInsertThenMergeDeleteOnSync)."""
+    result = init(rng, users=2)
+    array0, array1 = result["array0"], result["array1"]
+    array0.insert(0, ["x", "y", "z"])
+    result["testConnector"].flush_all_messages()
+    result["users"][0].disconnect()
+    array1.delete(0, 3)
+    result["users"][0].connect()
+    compare(result["users"])
+
+
+def test_garbage_collector(rng):
+    """(reference y-array.tests.js testGarbageCollector)."""
+    result = init(rng, users=3)
+    array0 = result["array0"]
+    array0.insert(0, ["x", "y", "z"])
+    result["testConnector"].flush_all_messages()
+    result["users"][0].disconnect()
+    array0.delete(0, 3)
+    result["users"][0].connect()
+    result["testConnector"].flush_all_messages()
+    compare(result["users"])
+
+
+def test_insert_and_delete_events(rng):
+    """(reference y-array.tests.js testInsertAndDeleteEvents)."""
+    result = init(rng, users=2)
+    array0 = result["array0"]
+    seen = []
+    array0.observe(lambda e, _tr=None: seen.append(e))
+    array0.insert(0, [0, 1, 2])
+    assert len(seen) == 1
+    array0.delete(0, 1)
+    assert len(seen) == 2
+    array0.delete(0, 2)
+    assert len(seen) == 3
+    compare(result["users"])
+
+
+def test_nested_observer_events(rng):
+    """Observer re-entrancy: an insert from inside an observer fires the
+    observer again AFTER the current call completes (reference
+    y-array.tests.js testNestedObserverEvents)."""
+    result = init(rng, users=2)
+    array0 = result["array0"]
+    vals = []
+
+    def obs(e, _tr=None):
+        if array0.length == 1:
+            array0.insert(1, [1])
+            vals.append(0)
+        else:
+            vals.append(1)
+
+    array0.observe(obs)
+    array0.insert(0, [0])
+    assert vals == [0, 1]
+    assert array0.to_array() == [0, 1]
+    compare(result["users"])
+
+
+def test_change_event_payload(rng):
+    """event.changes added/deleted sizes + delta shapes (reference
+    y-array.tests.js testChangeEvent)."""
+    result = init(rng, users=2)
+    array0 = result["array0"]
+    box = {}
+
+    def obs(e, _tr=None):
+        box["changes"] = e.changes
+
+    array0.observe(obs)
+    new_arr = Y.YArray()
+    array0.insert(0, [new_arr, 4, "dtrn"])
+    ch = box.pop("changes")
+    assert len(ch["added"]) == 2 and len(ch["deleted"]) == 0
+    assert ch["delta"] == [{"insert": [new_arr, 4, "dtrn"]}]
+    array0.delete(0, 2)
+    ch = box.pop("changes")
+    assert len(ch["added"]) == 0 and len(ch["deleted"]) == 2
+    assert ch["delta"] == [{"delete": 2}]
+    array0.insert(1, [0.1])
+    ch = box.pop("changes")
+    assert len(ch["added"]) == 1 and len(ch["deleted"]) == 0
+    assert ch["delta"] == [{"retain": 1}, {"insert": [0.1]}]
+    compare(result["users"])
+
+
+def test_event_target_is_set_correctly(rng):
+    """(reference y-array.tests.js testEventTargetIsSetCorrectlyOnLocal /
+    OnRemote)."""
+    result = init(rng, users=3)
+    array0, array1 = result["array0"], result["array1"]
+    box = {}
+    array0.observe(lambda e, _tr=None: box.__setitem__("t", e.target))
+    array0.insert(0, ["stuff"])
+    assert box["t"] is array0
+    box2 = {}
+    array1.observe(lambda e, _tr=None: box2.__setitem__("t", e.target))
+    result["testConnector"].flush_all_messages()
+    assert box2["t"] is array1
+    compare(result["users"])
+
+
+def test_iterating_array_containing_types():
+    """(reference y-array.tests.js testIteratingArrayContainingTypes)."""
+    y = Y.Doc()
+    arr = y.get_array("arr")
+    for i in range(10):
+        m = Y.YMap()
+        m.set("value", i)
+        arr.push([m])
+    for cnt, item in enumerate(arr.to_array()):
+        assert item.get("value") == cnt

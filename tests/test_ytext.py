@@ -205,3 +205,114 @@ TEXT_MODS = [_insert_text, _delete_text, _format_text, _insert_embed]
 @pytest.mark.parametrize("iterations", [6, 40, 100])
 def test_repeat_random_text_ops(rng, iterations):
     apply_random_tests(rng, TEXT_MODS, iterations)
+
+
+def test_get_delta_with_embeds(rng):
+    """(reference y-text.tests.js testGetDeltaWithEmbeds)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.apply_delta([{"insert": {"linebreak": "s"}}])
+    assert text0.to_delta() == [{"insert": {"linebreak": "s"}}]
+
+
+def test_to_json(rng):
+    """(reference y-text.tests.js testToJson)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.insert(0, "abc", {"bold": True})
+    assert text0.to_json() == "abc"
+
+
+def test_to_delta_embed_attributes(rng):
+    """(reference y-text.tests.js testToDeltaEmbedAttributes)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.insert(0, "ab", {"bold": True})
+    text0.insert_embed(1, {"image": "imageSrc.png"}, {"width": 100})
+    assert text0.to_delta() == [
+        {"insert": "a", "attributes": {"bold": True}},
+        {"insert": {"image": "imageSrc.png"}, "attributes": {"width": 100}},
+        {"insert": "b", "attributes": {"bold": True}},
+    ]
+
+
+def test_to_delta_embed_no_attributes(rng):
+    """(reference y-text.tests.js testToDeltaEmbedNoAttributes)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.insert(0, "ab", {"bold": True})
+    text0.insert_embed(1, {"image": "imageSrc.png"})
+    assert text0.to_delta() == [
+        {"insert": "a", "attributes": {"bold": True}},
+        {"insert": {"image": "imageSrc.png"}},
+        {"insert": "b", "attributes": {"bold": True}},
+    ]
+
+
+def test_formatting_removed(rng):
+    """Format-cleanup corner: deleting every formatted char leaves one
+    struct (reference y-text.tests.js testFormattingRemoved)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.insert(0, "ab", {"bold": True})
+    text0.delete(0, 2)
+    assert len(Y.get_type_children(text0)) == 1
+
+
+def test_formatting_removed_in_mid_text(rng):
+    """(reference y-text.tests.js testFormattingRemovedInMidText)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.insert(0, "1234")
+    text0.insert(2, "ab", {"bold": True})
+    text0.delete(2, 2)
+    assert len(Y.get_type_children(text0)) == 3
+
+
+def test_append_chars(rng):
+    """(reference y-text.tests.js testAppendChars, N scaled down)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    n = 3000
+    for _ in range(n):
+        text0.insert(text0.length, "a")
+    assert text0.length == n
+
+
+def test_text_snapshot_diff(rng):
+    """Two-snapshot diff with ychange (reference y-text.tests.js
+    testSnapshot)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    doc0 = text0.doc
+    doc0.gc = False
+    text0.apply_delta([{"insert": "abcd"}])
+    snapshot1 = Y.snapshot(doc0)
+    text0.apply_delta([{"retain": 1}, {"insert": "x"}, {"delete": 1}])
+    snapshot2 = Y.snapshot(doc0)
+    text0.apply_delta(
+        [{"retain": 2}, {"delete": 3}, {"insert": "x"}, {"delete": 1}]
+    )
+    assert text0.to_delta(snapshot1) == [{"insert": "abcd"}]
+    assert text0.to_delta(snapshot2) == [{"insert": "axcd"}]
+    state2_diff = text0.to_delta(snapshot2, snapshot1)
+    for v in state2_diff:
+        if "attributes" in v and "ychange" in v["attributes"]:
+            v["attributes"]["ychange"].pop("user", None)
+    assert state2_diff == [
+        {"insert": "a"},
+        {"insert": "x", "attributes": {"ychange": {"type": "added"}}},
+        {"insert": "b", "attributes": {"ychange": {"type": "removed"}}},
+        {"insert": "cd"},
+    ]
+
+
+def test_text_snapshot_delete_after(rng):
+    """(reference y-text.tests.js testSnapshotDeleteAfter)."""
+    result = init(rng, users=1)
+    text0 = result["text0"]
+    text0.doc.gc = False
+    text0.apply_delta([{"insert": "abcd"}])
+    snapshot1 = Y.snapshot(text0.doc)
+    text0.apply_delta([{"retain": 4}, {"insert": "e"}])
+    assert text0.to_delta(snapshot1) == [{"insert": "abcd"}]
