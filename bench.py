@@ -419,6 +419,9 @@ def main():
 
     b4 = bench_b4_broadcast(n_docs_b4)
     distinct, eng = bench_distinct(n_docs_distinct, n_ops)
+    # let the timed loop's freed engines finish their device-side buffer
+    # deletes before timing sync (cleanup RPCs share the host core)
+    time.sleep(3)
     sync = bench_sync(eng, n_docs_distinct)
 
     node_proxy_b4 = b4["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
