@@ -87,6 +87,15 @@ struct Reader {
     uint64_t end = ofs + blen;
     if (end > len) { fail = true; return 0; }
     for (uint64_t i = ofs; i < end; ) {
+      // ASCII fast path: count 8 valid bytes per iteration
+      while (i + 8 <= end) {
+        uint64_t w;
+        __builtin_memcpy(&w, buf + i, 8);
+        if (w & 0x8080808080808080ull) break;
+        units += 8;
+        i += 8;
+      }
+      if (i >= end) break;
       uint8_t b = buf[i];
       uint64_t n;
       if (b < 0x80) { n = 1; units += 1; }
