@@ -99,3 +99,55 @@ def test_is_visible():
     item = text._start
     assert Y.is_visible(item, snap)
     assert Y.is_visible(item, None) == (not item.deleted)
+
+
+def test_deleted_items_base():
+    """(reference snapshot.tests.js testDeletedItemsBase)."""
+    doc = Y.Doc(gc=False)
+    doc.get_array("array").insert(0, ["item1"])
+    doc.get_array("array").delete(0, 1)
+    snap = Y.snapshot(doc)
+    doc.get_array("array").insert(0, ["item0"])
+    restored = Y.create_doc_from_snapshot(doc, snap)
+    assert restored.get_array("array").to_array() == []
+    assert doc.get_array("array").to_array() == ["item0"]
+
+
+def test_deleted_items_2():
+    """(reference snapshot.tests.js testDeletedItems2)."""
+    doc = Y.Doc(gc=False)
+    doc.get_array("array").insert(0, ["item1", "item2", "item3"])
+    doc.get_array("array").delete(1, 1)
+    snap = Y.snapshot(doc)
+    doc.get_array("array").insert(0, ["item0"])
+    restored = Y.create_doc_from_snapshot(doc, snap)
+    assert restored.get_array("array").to_array() == ["item1", "item3"]
+    assert doc.get_array("array").to_array() == ["item0", "item1", "item3"]
+
+
+def test_dependent_changes(rng):
+    """(reference snapshot.tests.js testDependentChanges)."""
+    from helpers import init
+
+    result = init(rng, users=2)
+    array0, array1 = result["array0"], result["array1"]
+    tcn = result["testConnector"]
+    array0.doc.gc = False
+    array1.doc.gc = False
+    array0.insert(0, ["user1item1"])
+    tcn.sync_all()
+    array1.insert(1, ["user2item1"])
+    tcn.sync_all()
+    snap = Y.snapshot(array0.doc)
+    array0.insert(2, ["user1item2"])
+    tcn.sync_all()
+    array1.insert(3, ["user2item2"])
+    tcn.sync_all()
+    restored0 = Y.create_doc_from_snapshot(array0.doc, snap)
+    assert restored0.get_array("array").to_array() == [
+        "user1item1", "user2item1"
+    ]
+    restored1 = Y.create_doc_from_snapshot(array1.doc, snap)
+    assert restored1.get_array("array").to_array() == [
+        "user1item1", "user2item1"
+    ]

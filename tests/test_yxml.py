@@ -1,6 +1,7 @@
 """XML types (scenarios modeled on reference tests/y-xml.tests.js)."""
 
 import yjs_tpu as Y
+from helpers import compare, init
 
 
 def test_custom_typings():
@@ -98,3 +99,59 @@ def test_xml_fragment_first_child():
     a = Y.YXmlElement("a")
     xml.insert(0, [a])
     assert xml.first_child is a
+
+
+def test_xml_events(rng):
+    """attributesChanged / childListChanged, local + remote (reference
+    y-xml.tests.js testEvents)."""
+    result = init(rng, users=2)
+    xml0, xml1 = result["xml0"], result["xml1"]
+    box = {}
+    xml0.observe(lambda e, _tr=None: box.__setitem__("l", e))
+    xml1.observe(lambda e, _tr=None: box.__setitem__("r", e))
+
+    def fresh(side):
+        # stale events must not satisfy later steps' assertions
+        return box.pop(side)
+
+    xml0.set_attribute("key", "value")
+    assert "key" in fresh("l").attributes_changed
+    result["testConnector"].flush_all_messages()
+    assert "key" in fresh("r").attributes_changed
+    xml0.remove_attribute("key")
+    assert "key" in fresh("l").attributes_changed
+    result["testConnector"].flush_all_messages()
+    assert "key" in fresh("r").attributes_changed
+    xml0.insert(0, [Y.YXmlText("some text")])
+    assert fresh("l").child_list_changed
+    result["testConnector"].flush_all_messages()
+    assert fresh("r").child_list_changed
+    xml0.delete(0, 1)
+    assert fresh("l").child_list_changed
+    result["testConnector"].flush_all_messages()
+    assert fresh("r").child_list_changed
+    compare(result["users"])
+
+
+def test_insert_after():
+    """(reference y-xml.tests.js testInsertafter)."""
+    import pytest
+
+    ydoc = Y.Doc()
+    yxml = ydoc.get_xml_fragment("xml")
+    first = Y.YXmlText()
+    second = Y.YXmlElement("p")
+    third = Y.YXmlElement("p")
+    deepsecond1 = Y.YXmlElement("span")
+    deepsecond2 = Y.YXmlText()
+    second.insert_after(None, [deepsecond1])
+    second.insert_after(deepsecond1, [deepsecond2])
+    yxml.insert_after(None, [first, second])
+    yxml.insert_after(second, [third])
+    assert yxml.length == 3
+    assert second.get(0) is deepsecond1
+    assert second.get(1) is deepsecond2
+    assert yxml.to_array() == [first, second, third]
+    el = Y.YXmlElement("p")
+    with pytest.raises(LookupError):
+        el.insert_after(deepsecond1, [Y.YXmlText()])
