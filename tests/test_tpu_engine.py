@@ -724,3 +724,88 @@ class TestCompactionScale:
         eng.flush()
         for i in (0, 55, n_docs - 1):
             assert eng.text(i) == docs[i].get_text("text").to_string()
+
+
+class TestChunkedFlushStress:
+    """Adversarial coverage of the chunked batched flush (r4): capacity
+    growth BETWEEN chunks mid-flush, duplicated/out-of-order delivery,
+    and causal gaps parked/resumed across chunk boundaries."""
+
+    def test_uneven_growth_across_chunks(self, monkeypatch):
+        from yjs_tpu.ops.native_mirror import native_plan_available
+
+        if not native_plan_available():
+            pytest.skip("chunked batched flush requires the native planner")
+        monkeypatch.setenv("YTPU_FLUSH_CHUNK", "8")
+        rng = random.Random(42)
+        n = 48
+        eng = BatchEngine(n, compact_min_rows=16)
+        docs = [make_doc(100 + i) for i in range(n)]
+        for rnd in range(5):
+            batches = []
+            for i, d in enumerate(docs):
+                t = d.get_text("text")
+                size = rng.choice([1, 3, 200])  # uneven chunk-local caps
+                pos = rng.randint(0, len(t.to_string()))
+                t.insert(pos, "x" * size + f"[{rnd}.{i}]")
+                if rng.random() < 0.4 and len(t.to_string()) > 10:
+                    t.delete(rng.randint(0, 5), 5)
+                batches.append(Y.encode_state_as_update(d))
+            order = list(range(n))
+            rng.shuffle(order)
+            for i in order:
+                eng.queue_update(i, batches[i])
+                if rng.random() < 0.2:
+                    eng.queue_update(i, batches[i])  # duplicate delivery
+            eng.flush()
+            assert not eng.fallback, eng.demotions  # fast path every round
+        for i in range(n):
+            assert_engine_matches(eng, docs[i], i)
+
+    def test_causal_gaps_park_and_resume(self, monkeypatch):
+        from yjs_tpu.ops.native_mirror import native_plan_available
+
+        if not native_plan_available():
+            pytest.skip("chunked batched flush requires the native planner")
+        monkeypatch.setenv("YTPU_FLUSH_CHUNK", "4")
+        rng = random.Random(7)
+        n = 24
+        eng = BatchEngine(n)
+        docs = [make_doc(100 + i) for i in range(n)]
+        peers = [make_doc(500 + i) for i in range(n)]
+        svs = [None] * n
+        held = [[] for _ in range(n)]
+        for rnd in range(8):
+            for i in range(n):
+                d = docs[i]
+                t = d.get_text("text")
+                t.insert(rng.randint(0, len(t.to_string())), f"a{rnd}")
+                u = Y.encode_state_as_update(d, svs[i])
+                svs[i] = Y.encode_state_vector(d)
+                if rng.random() < 0.4:
+                    held[i].append(u)  # causal gap until released below
+                else:
+                    eng.queue_update(i, u)
+                    for h in reversed(held[i]):
+                        eng.queue_update(i, h)
+                    held[i].clear()
+                if rng.random() < 0.3:
+                    p = peers[i]
+                    Y.apply_update(p, Y.encode_state_as_update(d))
+                    p.get_text("text").insert(0, f"P{rnd}.")
+                    pu = Y.encode_state_as_update(
+                        p, Y.encode_state_vector(d)
+                    )
+                    Y.apply_update(d, pu)
+                    svs[i] = Y.encode_state_vector(d)
+                    eng.queue_update(i, pu)
+            eng.flush()
+            assert not eng.fallback, eng.demotions
+        for i in range(n):
+            for h in held[i]:
+                eng.queue_update(i, h)
+        eng.flush()
+        assert not eng.fallback, eng.demotions
+        for i in range(n):
+            assert_engine_matches(eng, docs[i], i)
+        assert eng.last_flush_metrics["n_pending_docs"] == 0
