@@ -1,0 +1,79 @@
+"""PermanentUserData + the content-ref dispatch table (reference
+tests/encoding.tests.js testPermanentUserData / testStructReferences)."""
+
+import yjs_tpu as Y
+from yjs_tpu.core import (
+    content_refs,
+    read_content_any,
+    read_content_binary,
+    read_content_deleted,
+    read_content_doc,
+    read_content_embed,
+    read_content_format,
+    read_content_json,
+    read_content_string,
+    read_content_type,
+)
+
+
+def test_struct_references():
+    """The wire content-ref table wiring (reference encoding.tests.js
+    testStructReferences): ref N must dispatch to the right reader, or
+    every udpate with that content kind decodes as garbage."""
+    assert len(content_refs) == 10
+    assert content_refs[1] is read_content_deleted
+    assert content_refs[2] is read_content_json
+    assert content_refs[3] is read_content_binary
+    assert content_refs[4] is read_content_string
+    assert content_refs[5] is read_content_embed
+    assert content_refs[6] is read_content_format
+    assert content_refs[7] is read_content_type
+    assert content_refs[8] is read_content_any
+    assert content_refs[9] is read_content_doc
+
+
+def test_permanent_user_data():
+    """(reference encoding.tests.js testPermanentUserData)."""
+    ydoc1 = Y.Doc(gc=False)
+    ydoc2 = Y.Doc(gc=False)
+    pd1 = Y.PermanentUserData(ydoc1)
+    pd2 = Y.PermanentUserData(ydoc2)
+    pd1.set_user_mapping(ydoc1, ydoc1.client_id, "user a")
+    pd2.set_user_mapping(ydoc2, ydoc2.client_id, "user b")
+    ydoc1.get_text("").insert(0, "xhi")
+    ydoc1.get_text("").delete(0, 1)
+    ydoc2.get_text("").insert(0, "hxxi")
+    ydoc2.get_text("").delete(1, 2)
+    Y.apply_update(ydoc2, Y.encode_state_as_update(ydoc1))
+    Y.apply_update(ydoc1, Y.encode_state_as_update(ydoc2))
+
+    # user lookup by live client id and by deleted-item id
+    assert pd1.get_user_by_client_id(ydoc1.client_id) == "user a"
+    assert pd1.get_user_by_client_id(ydoc2.client_id) == "user b"
+    from yjs_tpu.core import create_delete_set_from_struct_store
+    from yjs_tpu.ids import create_id
+
+    ds = create_delete_set_from_struct_store(ydoc1.store)
+    del_item = ds.clients[ydoc1.client_id][0]
+    assert (
+        pd1.get_user_by_deleted_id(
+            create_id(ydoc1.client_id, del_item.clock)
+        )
+        == "user a"
+    )
+    # the remote peer's deletions arrived as an encoded DeleteSet through
+    # the users-map observer — attribute them to "user b" on doc1's side
+    del_item_b = ds.clients[ydoc2.client_id][0]
+    assert (
+        pd1.get_user_by_deleted_id(
+            create_id(ydoc2.client_id, del_item_b.clock)
+        )
+        == "user b"
+    )
+
+    # a third doc synced from doc1 re-attaches under the same name
+    ydoc3 = Y.Doc(gc=False)
+    Y.apply_update(ydoc3, Y.encode_state_as_update(ydoc1))
+    pd3 = Y.PermanentUserData(ydoc3)
+    pd3.set_user_mapping(ydoc3, ydoc3.client_id, "user a")
+    assert pd3.get_user_by_client_id(ydoc1.client_id) == "user a"
