@@ -261,6 +261,33 @@ class TestFallback:
         assert eng.demotions[0]["reason"] == "subdocument (content ref 9)"
         assert eng.text(0) == "hi"
 
+    def test_mixed_demotions_inside_chunked_flush(self, monkeypatch):
+        """Docs demoting mid-chunk (subdoc updates) must not disturb the
+        rest of the batched flush: per-doc rc routing in prepare_many."""
+        monkeypatch.setenv("YTPU_FLUSH_CHUNK", "8")
+        n = 20
+        eng = BatchEngine(n)
+        docs = [make_doc(200 + i) for i in range(n)]
+        for i, d in enumerate(docs):
+            d.get_text("text").insert(0, f"doc{i} body")
+            if i % 7 == 3:  # 3, 10, 17 -> one demotion per chunk
+                d.get_map("m").set("sub", Y.Doc(guid=f"child{i}"))
+            eng.queue_update(i, Y.encode_state_as_update(d))
+        eng.flush()
+        demoted = {i for i in range(n) if i % 7 == 3}
+        assert set(eng.fallback) == demoted
+        assert len(eng.demotions) == len(demoted)
+        for i in range(n):
+            assert eng.text(i) == docs[i].get_text("text").to_string(), i
+        # native docs keep flowing through later chunked flushes
+        for i, d in enumerate(docs):
+            d.get_text("text").insert(0, "more ")
+            eng.queue_update(i, Y.encode_state_as_update(d))
+        eng.flush()
+        assert set(eng.fallback) == demoted  # no new demotions
+        for i in range(n):
+            assert eng.text(i) == docs[i].get_text("text").to_string(), i
+
 
 class TestNestedTypes:
     """Nested shared types integrate on device as parent-row-keyed segments
