@@ -798,7 +798,9 @@ struct Mirror {
       uint64_t clock = rest->varuint();
       for (uint64_t s = 0; s < n_structs; s++) {
         if (v.any_fail()) return kErrMalformed;
-        PendRef p;
+        // build in place (mirrors scan_v1): no 176-byte copy per struct
+        out->emplace_back();
+        PendRef& p = out->back();
         p.client = client;
         p.clock = (int64_t)clock;
         p.c.v2 = 1;
@@ -909,7 +911,6 @@ struct Mirror {
         }
         if (v.any_fail()) return kErrMalformed;
         if (p.length == 0 && ref != 0) return kErrMalformed;
-        out->push_back(p);
         clock += (uint64_t)p.length;
       }
     }
