@@ -32,7 +32,8 @@ def _build() -> bool:
     try:
         srcs = [_SRC] + ([_SRC_PLAN] if os.path.exists(_SRC_PLAN) else [])
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO] + srcs,
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             "-o", _SO] + srcs,
             check=True,
             capture_output=True,
             timeout=240,
@@ -197,6 +198,14 @@ def load():
         lib._has_compact_self = True
     except AttributeError:
         lib._has_compact_self = False
+    try:
+        # r5 diagnostic: ymx_prepare_many's worker-pool width (surfaced as
+        # last_flush_metrics["plan_threads"])
+        lib.ymx_plan_threads.restype = ctypes.c_int
+        lib.ymx_plan_threads.argtypes = []
+        lib._has_plan_threads = True
+    except AttributeError:
+        lib._has_plan_threads = False
     _lib = lib
     return _lib
 

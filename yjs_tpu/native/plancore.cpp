@@ -16,12 +16,21 @@
 // same buffer table.  All plan/state getters fill caller-allocated numpy
 // arrays.  Row content is described by (src_kind, buf, ofs, end, ...)
 // descriptor columns; Python realizes payload objects lazily from these.
+//
+// Threading contract: a Mirror handle must NOT be used from two threads
+// concurrently — even read-only getters may touch mutable lookup hints
+// (frag_hint).  The ymx_prepare_many worker pool honors this by
+// parallelizing ACROSS doc handles, never within one; Python callers that
+// share a doc across threads must serialize per doc (BatchEngine does —
+// all native calls for a doc happen on the flush thread).
 
 #include "wire.h"
 
+#include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
+#include <thread>
 
 #include <algorithm>
 #include <array>
@@ -123,6 +132,13 @@ struct Mirror {
   std::unordered_map<int64_t, int64_t> slot_of_client;
   // per-slot fragment index sorted by clock, and next expected clock
   std::vector<std::vector<int64_t>> frag_clock, frag_row;
+  // per-slot last frag_containing hit: lookups chain forward one fragment
+  // at a time (origin cuts / delete walks), so checking hint and hint+1
+  // before the binary search hits most of the time.  Purely an index
+  // guess — every use re-verifies bounds against the live frag lists, so
+  // stale values (splits/compaction reindexing) cost a miss, never a
+  // wrong answer.
+  mutable std::vector<int64_t> frag_hint;
   std::vector<int64_t> state;
 
   // per-row columns
@@ -192,13 +208,19 @@ struct Mirror {
     return id;
   }
 
-  // one-entry cache: consecutive refs overwhelmingly share a client
-  // (slots are never removed, so the cache can only go stale on value,
-  // never on existence)
-  int64_t last_slot_client = INT64_MIN, last_slot_val = kNull;
+  // tiny round-robin cache: each ref touches up to three clients (self,
+  // origin, right-origin), so one entry thrashes; four cover the working
+  // set.  Slots are never removed, so entries can only go stale on
+  // nothing — a cached (client, slot) pair stays true forever.
+  static constexpr int kSlotCache = 4;
+  int64_t slot_cache_cl[kSlotCache] = {INT64_MIN, INT64_MIN, INT64_MIN,
+                                       INT64_MIN};
+  int64_t slot_cache_v[kSlotCache] = {kNull, kNull, kNull, kNull};
+  int slot_cache_pos = 0;
 
   int64_t slot(int64_t client) {
-    if (client == last_slot_client) return last_slot_val;
+    for (int i = 0; i < kSlotCache; i++)
+      if (slot_cache_cl[i] == client) return slot_cache_v[i];
     int64_t s;
     auto it = slot_of_client.find(client);
     if (it != slot_of_client.end()) {
@@ -209,10 +231,12 @@ struct Mirror {
       client_of_slot.push_back(client);
       frag_clock.emplace_back();
       frag_row.emplace_back();
+      frag_hint.push_back(0);
       state.push_back(0);
     }
-    last_slot_client = client;
-    last_slot_val = s;
+    slot_cache_cl[slot_cache_pos] = client;
+    slot_cache_v[slot_cache_pos] = s;
+    slot_cache_pos = (slot_cache_pos + 1) & (kSlotCache - 1);
     return s;
   }
 
@@ -416,18 +440,20 @@ struct Mirror {
     r_lww_deleted.reserve(want); list_next.reserve(want);
   }
 
+  // oslot_/rslot_ are PRE-RESOLVED slots (kNull = no origin): every caller
+  // has already paid the client->slot lookup, so add_row must not repeat it
   int64_t add_row(int64_t slot_, int64_t clock, int64_t length,
-                  int64_t oc, int64_t ok_, int64_t rc, int64_t rk,
+                  int64_t oslot_, int64_t ok_, int64_t rslot_, int64_t rk,
                   bool is_gc, const ContentDesc& c, int64_t ref,
                   int64_t seg_) {
     int64_t row = n_rows();
     r_slot.push_back(slot_);
     r_clock.push_back(clock);
     r_len.push_back(length);
-    if (oc < 0) { r_oslot.push_back(kNull); r_oclock.push_back(0); }
-    else { r_oslot.push_back(slot(oc)); r_oclock.push_back(ok_); }
-    if (rc < 0) { r_rslot.push_back(kNull); r_rclock.push_back(0); }
-    else { r_rslot.push_back(slot(rc)); r_rclock.push_back(rk); }
+    if (oslot_ == kNull) { r_oslot.push_back(kNull); r_oclock.push_back(0); }
+    else { r_oslot.push_back(oslot_); r_oclock.push_back(ok_); }
+    if (rslot_ == kNull) { r_rslot.push_back(kNull); r_rclock.push_back(0); }
+    else { r_rslot.push_back(rslot_); r_rclock.push_back(rk); }
     r_is_gc.push_back(is_gc ? 1 : 0);
     r_countable.push_back((!is_gc && ref != 0 && ref != 1 && ref != 6) ? 1 : 0);
     r_c.push_back(c);
@@ -459,16 +485,30 @@ struct Mirror {
   // index into the frag lists of the fragment covering `clock`, or -1
   int64_t frag_containing(int64_t slot_, int64_t clock) const {
     const auto& fc = frag_clock[slot_];
-    if (fc.empty()) return kNull;
+    int64_t n = (int64_t)fc.size();
+    if (n == 0) return kNull;
     // fast path: appends dominate, so most lookups hit the last fragment
     if (clock >= fc.back()) {
-      int64_t i = (int64_t)fc.size() - 1;
+      int64_t i = n - 1;
       int64_t row = frag_row[slot_][(size_t)i];
       return clock < r_clock[row] + r_len[row] ? i : kNull;
     }
-    auto it = std::upper_bound(fc.begin(), fc.end(), clock);
-    int64_t i = (int64_t)(it - fc.begin()) - 1;
+    // hint path: chained lookups land on the same or the next fragment
+    int64_t i;
+    int64_t h = frag_hint[slot_];
+    if (h >= 0 && h + 1 < n && fc[(size_t)h] <= clock) {
+      if (clock < fc[(size_t)h + 1]) i = h;
+      else if (h + 2 < n ? clock < fc[(size_t)h + 2]
+                         : clock < fc.back())
+        i = h + 1;
+      else
+        i = std::upper_bound(fc.begin() + h + 2, fc.end(), clock) -
+            fc.begin() - 1;
+    } else {
+      i = std::upper_bound(fc.begin(), fc.end(), clock) - fc.begin() - 1;
+    }
     if (i < 0) return kNull;
+    frag_hint[slot_] = i;
     int64_t row = frag_row[slot_][(size_t)i];
     if (clock < r_clock[row] + r_len[row]) return i;
     return kNull;
@@ -482,11 +522,9 @@ struct Mirror {
     if (!*ok) return kNull;
     gen++;
     int64_t sg = r_seg[row];
-    int64_t rslt = r_rslot[row] == kNull ? kNull
-                   : client_of_slot[r_rslot[row]];
     int64_t new_row = add_row(
         slot_, at_clock, r_len[row] - offset,
-        client_of_slot[slot_], at_clock - 1, rslt, r_rclock[row],
+        slot_, at_clock - 1, r_rslot[row], r_rclock[row],
         false, right, r_ref[row], sg);
     r_len[row] = offset;
     plan.splits.push_back({{row, new_row}});
@@ -978,97 +1016,130 @@ struct Mirror {
     lap("scan");
     pending_ds.clear();
 
-    // merge into the pending queues, clock-sorted (stable).  The common
-    // case — one ordered update per client, empty queue — is already
-    // sorted; skip the fat-struct stable_sort then.  Relative per-client
-    // order of all_refs matches the old grouped flow (scan order).
-    // Clients interleave ref-by-ref in merged updates, so the queue
-    // lookup rides a small linear cache (few clients), not a tree probe
-    // per switch.
+    // merge into per-client WORKING SETS of pointers (old pending refs
+    // first, then this call's scan output, stable-sorted by clock) — the
+    // same order the old fat-struct queues had, without moving a single
+    // 176-byte PendRef.  `pending` stays untouched until the end of the
+    // call, when only the UNCONSUMED tail is copied back (common case:
+    // empty).  all_refs is function-scoped, so the pointers outlive every
+    // consumer (fixpoint, cuts-collect, rows).
+    // Clients interleave ref-by-ref in merged updates, so the working-set
+    // lookup rides a small linear cache (few clients), spilling to a map
+    // past kLinearClients.
+    constexpr size_t kLinearClients = 32;
+    std::vector<std::pair<int64_t, std::vector<PendRef*>>> qwork_lin;
+    std::unordered_map<int64_t, std::vector<PendRef*>> qwork_wide;
     {
-      // linear caches are faster than hashing for the common few-client
-      // case but quadratic past that; spill to a map when wide
-      constexpr size_t kLinearClients = 32;
-      std::vector<std::pair<int64_t, int64_t>> qcount;
-      std::unordered_map<int64_t, int64_t> qcount_wide;
-      for (auto& p : all_refs) {
-        if (qcount.size() >= kLinearClients) {
-          if (qcount_wide.empty())
-            qcount_wide.insert(qcount.begin(), qcount.end());
-          qcount_wide[p.client]++;
-          continue;
+      auto qwork_of = [&](int64_t cl) -> std::vector<PendRef*>& {
+        if (!qwork_wide.empty()) return qwork_wide[cl];
+        for (auto& [c, w] : qwork_lin)
+          if (c == cl) return w;
+        if (qwork_lin.size() >= kLinearClients) {
+          for (auto& [c, w] : qwork_lin)
+            qwork_wide.emplace(c, std::move(w));
+          qwork_lin.clear();
+          return qwork_wide[cl];
         }
-        bool hit = false;
-        for (auto& [cl, n] : qcount)
-          if (cl == p.client) { n++; hit = true; break; }
-        if (!hit) qcount.emplace_back(p.client, 1);
-      }
-      const bool wide = !qcount_wide.empty();
-      std::vector<std::pair<int64_t, std::vector<PendRef>*>> qcache;
-      std::unordered_map<int64_t, std::vector<PendRef>*> qcache_wide;
-      auto reserve_q = [&](int64_t cl, int64_t n) {
-        auto* q = &pending[cl];
-        q->reserve(q->size() + (size_t)n);
-        if (wide) qcache_wide.emplace(cl, q);
-        else qcache.emplace_back(cl, q);
+        qwork_lin.emplace_back(cl, std::vector<PendRef*>());
+        return qwork_lin.back().second;
       };
-      if (wide)
-        for (auto& [cl, n] : qcount_wide) reserve_q(cl, n);
-      else
-        for (auto& [cl, n] : qcount) reserve_q(cl, n);
+      for (auto& [cl, q] : pending) {
+        auto& w = qwork_of(cl);
+        w.reserve(q.size() + 16);
+        for (auto& r : q) w.push_back(&r);
+      }
+      int64_t cache_cl = INT64_MIN;
+      std::vector<PendRef*>* cache_w = nullptr;
       for (auto& p : all_refs) {
-        std::vector<PendRef>* q = nullptr;
-        if (wide) {
-          q = qcache_wide[p.client];
-        } else {
-          for (auto& [cl, qp] : qcache)
-            if (cl == p.client) { q = qp; break; }
+        if (p.client != cache_cl) {
+          cache_w = &qwork_of(p.client);
+          cache_cl = p.client;
         }
-        q->push_back(std::move(p));
+        cache_w->push_back(&p);
       }
-      all_refs.clear();
-      auto by_clock = [](const PendRef& a, const PendRef& b) {
-        return a.clock < b.clock;
+      auto by_clock = [](const PendRef* a, const PendRef* b) {
+        return a->clock < b->clock;
       };
-      if (wide) {
-        for (auto& [cl, qq] : qcache_wide)
-          if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
-            std::stable_sort(qq->begin(), qq->end(), by_clock);
-      } else {
-        for (auto& [cl, qq] : qcache)
-          if (!std::is_sorted(qq->begin(), qq->end(), by_clock))
-            std::stable_sort(qq->begin(), qq->end(), by_clock);
-      }
+      for (auto& [cl, w] : qwork_lin)
+        if (!std::is_sorted(w.begin(), w.end(), by_clock))
+          std::stable_sort(w.begin(), w.end(), by_clock);
+      for (auto& [cl, w] : qwork_wide)
+        if (!std::is_sorted(w.begin(), w.end(), by_clock))
+          std::stable_sort(w.begin(), w.end(), by_clock);
     }
+    // descending-client iteration order for the fixpoint (the old
+    // pending.rbegin() order), with consumed-prefix heads alongside
+    std::vector<std::pair<int64_t, std::vector<PendRef*>*>> clients_desc;
+    clients_desc.reserve(qwork_lin.size() + qwork_wide.size());
+    for (auto& [cl, w] : qwork_lin) clients_desc.emplace_back(cl, &w);
+    for (auto& [cl, w] : qwork_wide) clients_desc.emplace_back(cl, &w);
+    std::sort(clients_desc.begin(), clients_desc.end(),
+              [](const auto& a, const auto& b) { return a.first > b.first; });
+    std::vector<size_t> q_head(clients_desc.size(), 0);
 
     lap("merge");
     // causal scheduling: per-client queue fixpoint, descending client order
-    std::vector<PendRef> sched;
+    std::vector<PendRef*> sched;
     {
       size_t tot = 0;
-      for (auto& [c, q] : pending) tot += q.size();
+      for (auto& [c, w] : clients_desc) tot += w->size();
       sched.reserve(tot);
     }
-    std::unordered_map<int64_t, int64_t> overlay;
-    auto state_of = [&](int64_t client) {
-      auto it = overlay.find(client);
-      return it == overlay.end() ? get_state(client) : it->second;
+    // effective-state cache: the fixpoint probes state_of 3-4x per ref
+    // (dep checks + clock gate); the old overlay map cost two hash
+    // lookups per probe.  Live state[] never changes during the fixpoint
+    // (rows are added later), so caching get_state is safe.  Linear for
+    // the common few-client case, spilling to a map when wide.
+    constexpr size_t kLinearStClients = 32;
+    std::vector<std::pair<int64_t, int64_t>> st_lin;
+    std::unordered_map<int64_t, int64_t> st_wide;
+    auto state_of = [&](int64_t client) -> int64_t {
+      if (!st_wide.empty()) {
+        auto it = st_wide.find(client);
+        if (it != st_wide.end()) return it->second;
+        int64_t v = get_state(client);
+        st_wide.emplace(client, v);
+        return v;
+      }
+      for (auto& e : st_lin)
+        if (e.first == client) return e.second;
+      int64_t v = get_state(client);
+      if (st_lin.size() >= kLinearStClients) {
+        st_wide.insert(st_lin.begin(), st_lin.end());
+        st_lin.clear();  // same spill discipline as qwork_of above
+        st_wide.emplace(client, v);
+      } else {
+        st_lin.emplace_back(client, v);
+      }
+      return v;
+    };
+    auto bump_state = [&](int64_t client, int64_t v) {
+      if (!st_wide.empty()) {
+        st_wide[client] = v;
+        return;
+      }
+      for (auto& e : st_lin)
+        if (e.first == client) { e.second = v; return; }
+      if (st_lin.size() >= kLinearStClients) {
+        st_wide.insert(st_lin.begin(), st_lin.end());
+        st_lin.clear();
+        st_wide[client] = v;
+      } else {
+        st_lin.emplace_back(client, v);
+      }
     };
     auto dep_ok = [&](int64_t dc, int64_t dk, bool has, int64_t client) {
       return !has || dc == client || state_of(dc) > dk;
     };
-    // consumed-prefix head indexes (front erase on a vector of fat refs
-    // would be quadratic); prefixes are dropped once after the fixpoint
-    std::map<int64_t, size_t> q_head;
     bool progress = true;
     while (progress) {
       progress = false;
-      for (auto it = pending.rbegin(); it != pending.rend(); ++it) {
-        auto& q = it->second;
-        int64_t client = it->first;
-        size_t& head = q_head[client];
+      for (size_t ci = 0; ci < clients_desc.size(); ci++) {
+        int64_t client = clients_desc[ci].first;
+        auto& q = *clients_desc[ci].second;
+        size_t& head = q_head[ci];
         while (head < q.size()) {
-          PendRef& ref = q[head];
+          PendRef& ref = *q[head];
           int64_t st = state_of(client);
           if (ref.clock > st) break;
           if (ref.clock + ref.length <= st) {
@@ -1094,20 +1165,12 @@ struct Mirror {
               ref.ok = ref.clock - 1;
             }
           }
-          sched.push_back(std::move(ref));
-          overlay[client] = sched.back().clock + sched.back().length;
+          sched.push_back(&ref);
+          bump_state(client, ref.clock + ref.length);
           head++;
           progress = true;
         }
       }
-    }
-    for (auto it = pending.begin(); it != pending.end();) {
-      size_t head = q_head[it->first];
-      if (head > 0)
-        it->second.erase(it->second.begin(),
-                         it->second.begin() + (ptrdiff_t)head);
-      if (it->second.empty()) it = pending.erase(it);
-      else ++it;
     }
 
     lap("fixpoint");
@@ -1171,7 +1234,8 @@ struct Mirror {
       last_cut.push_back({cl, INT64_MIN, INT64_MIN});
       return &last_cut.back()[1];
     };
-    for (auto& ref : sched) {
+    for (const PendRef* rp : sched) {
+      const PendRef& ref = *rp;
       if (ref.oc >= 0) {
         int64_t* e = cut_slot(ref.oc);
         if (e[0] != ref.ok + 1) {
@@ -1239,16 +1303,17 @@ struct Mirror {
         return 0;
       }
       int64_t left_row = kNull, right_row = kNull;
+      int64_t oslot = kNull, rslot = kNull;
       bool degrade = false;
       if (ref.oc >= 0) {
-        int64_t oslot = slot(ref.oc);
+        oslot = slot(ref.oc);
         int64_t fi = frag_containing(oslot, ref.ok);
         if (fi == kNull) return kErrInternal;
         left_row = frag_row[oslot][(size_t)fi];
         if (r_is_gc[left_row]) degrade = true;
       }
       if (ref.rc >= 0) {
-        int64_t rslot = slot(ref.rc);
+        rslot = slot(ref.rc);
         int64_t fi = frag_containing(rslot, ref.rk);
         if (fi == kNull) return kErrInternal;
         right_row = frag_row[rslot][(size_t)fi];
@@ -1279,8 +1344,8 @@ struct Mirror {
       } else {
         return kErrUnsupported;  // item with no derivable parent
       }
-      int64_t row = add_row(slot_, ref.clock, ref.length, ref.oc, ref.ok,
-                            ref.rc, ref.rk, false, ref.c, ref.ref, sg);
+      int64_t row = add_row(slot_, ref.clock, ref.length, oslot, ref.ok,
+                            rslot, ref.rk, false, ref.c, ref.ref, sg);
       if (want_sched) plan.sched.push_back({{row, left_row, right_row, sg}});
       int64_t actual_left = list_insert(sg, row, left_row, right_row);
       if (seg_is_map(sg)) {
@@ -1303,20 +1368,43 @@ struct Mirror {
         applicable.push_back({{ref.client, ref.clock, ref.length}});
       return 0;
     };
-    for (auto& ref0 : sched) {
+    // per-ref cuts lookup cache + rolling cut cursor: sched's clocks
+    // ascend per client, so within a client run the cut cursor only moves
+    // forward (amortized O(1)); a client switch re-seeks once.  The hash
+    // find per ref is gone with it.
+    int64_t cuts_cl_cache = INT64_MIN;
+    std::vector<int64_t>* cuts_ks_cache = nullptr;
+    size_t cuts_idx_cache = 0;
+    for (const PendRef* rp0 : sched) {
+      const PendRef& ref0 = *rp0;
       // length-1 refs can never be fragmented (no strictly-interior cut)
-      auto cit = (ref0.is_gc || ref0.length <= 1) ? cuts.end()
-                                                  : cuts.find(ref0.client);
-      if (cit == cuts.end()) {
+      std::vector<int64_t>* ks_p = nullptr;
+      if (!ref0.is_gc && ref0.length > 1) {
+        if (ref0.client == cuts_cl_cache) {
+          ks_p = cuts_ks_cache;
+        } else {
+          auto cit = cuts.find(ref0.client);
+          ks_p = cit == cuts.end() ? nullptr : &cit->second;
+          cuts_cl_cache = ref0.client;
+          cuts_ks_cache = ks_p;
+          if (ks_p)
+            cuts_idx_cache =
+                std::upper_bound(ks_p->begin(), ks_p->end(), ref0.clock) -
+                ks_p->begin();
+        }
+      }
+      if (ks_p == nullptr) {
         int rc = emit_row(ref0);
         if (rc != 0) return rc;
         continue;
       }
       PendRef cur = ref0;
-      auto& ks = cit->second;
-      for (auto kit = std::upper_bound(ks.begin(), ks.end(), cur.clock);
-           kit != ks.end() && *kit < ref0.clock + ref0.length; ++kit) {
-        int64_t k = *kit;
+      auto& ks = *ks_p;
+      while (cuts_idx_cache < ks.size() && ks[cuts_idx_cache] <= cur.clock)
+        cuts_idx_cache++;
+      for (size_t ki = cuts_idx_cache;
+           ki < ks.size() && ks[ki] < ref0.clock + ref0.length; ++ki) {
+        int64_t k = ks[ki];
         if (k <= cur.clock) continue;
         PendRef right = cur;
         int64_t off = k - cur.clock;
@@ -1368,8 +1456,22 @@ struct Mirror {
     // path ships final links and skips the level assignment entirely
     if (want_levels) assign_levels();
     lap("levels");
-    // ascending row/seg order = the Python twin's `sorted(plan._dl)`
-    std::sort(plan.dirty_links.begin(), plan.dirty_links.end());
+    // ascending row/seg order = the Python twin's `sorted(plan._dl)`.
+    // When the dirty set is DENSE in the row range (bulk first flush),
+    // recollect it ascending by scanning the dl_mark epoch array — O(range)
+    // sequential loads beat an O(n log n) sort.  Sparse incremental
+    // flushes on big mirrors keep the sort.
+    {
+      size_t nd = plan.dirty_links.size();
+      if (nd > 16 && (size_t)n_rows() / 16 < nd) {
+        plan.dirty_links.clear();
+        size_t hi = std::min(dl_mark.size(), (size_t)n_rows());
+        for (size_t r = 0; r < hi; r++)
+          if (dl_mark[r] == dirty_epoch) plan.dirty_links.push_back((int64_t)r);
+      } else {
+        std::sort(plan.dirty_links.begin(), plan.dirty_links.end());
+      }
+    }
     std::sort(plan.dirty_heads.begin(), plan.dirty_heads.end());
     plan.link_rows.reserve(plan.dirty_links.size());
     plan.link_vals.reserve(plan.dirty_links.size());
@@ -1381,6 +1483,23 @@ struct Mirror {
       plan.head_segs.push_back(s);
       plan.head_vals.push_back(head_of_seg[(size_t)s]);
     }
+    // rebuild `pending` from the unconsumed working-set tails: only refs
+    // that failed the causal gate get a fat copy (common case: none).
+    // Deferred to here because sched/qwork hold pointers into the OLD
+    // pending vectors until the rows pass is done.
+    {
+      std::map<int64_t, std::vector<PendRef>> new_pending;
+      for (size_t ci = 0; ci < clients_desc.size(); ci++) {
+        auto& w = *clients_desc[ci].second;
+        size_t head = q_head[ci];
+        if (head >= w.size()) continue;
+        auto& q = new_pending[clients_desc[ci].first];
+        q.reserve(w.size() - head);
+        for (size_t j = head; j < w.size(); j++) q.push_back(*w[j]);
+      }
+      pending.swap(new_pending);
+    }
+    lap("finalize");
     gen++;
     return 0;
   }
@@ -2358,15 +2477,36 @@ int ymx_prepare(void* h, const int64_t* buf_ids, const int64_t* v2_flags,
   return 0;
 }
 
+// planner worker-pool width: YTPU_PLAN_THREADS wins, else the hardware
+// concurrency of the host (1 on this build image — the pool then takes
+// the serial path with zero thread overhead; real multi-core hosts fan
+// the per-doc plans out)
+static int plan_pool_width() {
+  const char* e = std::getenv("YTPU_PLAN_THREADS");
+  if (e && *e) {
+    int v = std::atoi(e);
+    return v > 0 ? v : 1;
+  }
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc > 0 ? (int)hc : 1;
+}
+
+int ymx_plan_threads() { return plan_pool_width(); }
+
 // batched twin of ymx_prepare: one call plans EVERY staged doc, writing a
 // 16-wide counts row per doc ([0..13] = ymx_prepare's layout, [14] =
 // dense-link flag: link_rows == [0..n_rows)) and a per-doc rc.  Kills the
 // per-doc Python/ctypes round trip that dominated distinct-doc flushes.
+// Per-doc plans are independent (each touches only its own Mirror; the
+// only shared data are the const update bytes), so the loop fans out over
+// a worker pool on multi-core hosts — results are bit-identical at any
+// width because no doc reads another doc's state.  Callers must not pass
+// the same handle twice in one call.
 void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
                       const int64_t* ids_flat, const int64_t* v2_flat,
                       int want_levels, int want_sched, int64_t* out_counts,
                       int64_t* out_rc) {
-  for (int64_t i = 0; i < n_docs; i++) {
+  auto plan_one = [&](int64_t i) {
     Mirror* m = static_cast<Mirror*>(hs[i]);
     int64_t lo = buf_ofs[i], hi = buf_ofs[i + 1];
     int rc = m->prepare(ids_flat + lo, v2_flat + lo, hi - lo,
@@ -2375,7 +2515,7 @@ void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
     int64_t* c = out_counts + i * 16;
     if (rc != 0) {
       for (int j = 0; j < 16; j++) c[j] = 0;
-      continue;
+      return;
     }
     int64_t depth = (int64_t)m->pending_ds.size();
     for (auto& [cl, q] : m->pending) depth += (int64_t)q.size();
@@ -2399,7 +2539,23 @@ void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
                 ? 1
                 : 0;
     c[15] = 0;
+  };
+  int nt = plan_pool_width();
+  if (nt > (int)n_docs) nt = (int)n_docs;
+  if (nt <= 1) {
+    for (int64_t i = 0; i < n_docs; i++) plan_one(i);
+    return;
   }
+  std::atomic<int64_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve((size_t)nt);
+  for (int t = 0; t < nt; t++)
+    pool.emplace_back([&] {
+      for (int64_t i; (i = next.fetch_add(1, std::memory_order_relaxed)) <
+                      n_docs;)
+        plan_one(i);
+    });
+  for (auto& th : pool) th.join();
 }
 
 // native twin of BatchEngine._flush_apply's pack loop: bins every doc's

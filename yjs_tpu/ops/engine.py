@@ -40,6 +40,24 @@ from .native_mirror import (
 from . import kernels
 
 
+def _native_plan_threads() -> int:
+    """Worker-pool width ymx_prepare_many fans out to (1 when the native
+    planner is unavailable or the host has a single core)."""
+    try:
+        from ..native import has_plancore, load
+
+        lib = load()
+        if (
+            lib is not None
+            and has_plancore()
+            and getattr(lib, "_has_plan_threads", False)
+        ):
+            return int(lib.ymx_plan_threads())
+    except Exception:
+        pass
+    return 1
+
+
 def make_mirror(root_name: str):
     """DocMirror served by the C++ plan core when available; the pure-
     Python mirror otherwise (no toolchain / YTPU_NO_NATIVE_PLAN)."""
@@ -988,6 +1006,9 @@ class BatchEngine:
             "t_dispatch_s": t_disp_acc,
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
+            # worker-pool width the native planner fanned per-doc plans
+            # out to (1 = serial; YTPU_PLAN_THREADS overrides)
+            "plan_threads": _native_plan_threads(),
         })
         self.last_flush_metrics = metrics
 
