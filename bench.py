@@ -318,8 +318,7 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
     # single runs 2-4x (BASELINE.md), and the server shape is steady-state.
     # ONE engine alive at a time (a server holds one engine; stacking
     # 200MB+ mirror states from prior runs thrashes the single host core)
-    runs = []
-    metrics_by_time = {}
+    runs = []  # (dt, flush metrics) pairs; sorted by dt for the median
     for _ in range(3):
         # free the previous engine and let the device-side buffer deletes
         # drain BEFORE the timed window (cleanup RPCs otherwise steal the
@@ -335,12 +334,10 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
         # readback barrier: force device completion
         np.asarray(eng._right[:, 0])
         dt = time.perf_counter() - t0
-        runs.append(dt)
-        metrics_by_time[dt] = eng.last_flush_metrics
+        runs.append((dt, eng.last_flush_metrics))
     gc.unfreeze()
-    runs.sort()
-    t_e2e = runs[1]  # median run's host phase timers, final run's engine
-    eng_metrics = metrics_by_time[t_e2e]
+    runs.sort(key=lambda p: p[0])
+    t_e2e, eng_metrics = runs[1]  # median run (its own metrics)
 
     # convergence spot-check on 3 docs (distinct traces -> meaningful)
     import yjs_tpu as Y

@@ -278,3 +278,130 @@ def test_engine_xml_string_matches_cpu(rng):
     expect = cpu.get_xml_fragment("xml").to_string()
     assert eng.xml_string(0) == expect
     assert expect  # non-trivial traffic
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r4 item 6: event-path INDEX parity.  getPathTo (YEvent.js:207-228)
+# counts undeleted ITEMS before the nested type — a count that depends on
+# run-merge state, which differs between the CPU store (merges eagerly at
+# cleanup) and the mirror (merges only at compaction).  These sessions put
+# nested types inside ARRAYS behind char-by-char typed prefixes (one update
+# per keystroke = maximally merge-sensitive) and behind deletions, for all
+# three list kinds: array, xml children, and nested array-in-array.
+# ---------------------------------------------------------------------------
+
+
+def _nested_list_session(rng, n_rounds=30):
+    a = Y.Doc(gc=False); a.client_id = 31
+    b = Y.Doc(gc=False); b.client_id = 42
+    updates = []
+    nested_keys = []
+    for rnd in range(n_rounds):
+        for d in (a, b):
+            sv = Y.encode_state_vector(d)
+            arr = d.get_array("list")
+            xml = d.get("xml", Y.YXmlElement)
+            op = rng.random()
+            if op < 0.35:
+                # char-by-char prefix typing: each keystroke is its own
+                # update, so the mirror holds N rows where the CPU store
+                # holds one merged item
+                arr.insert(rng.randint(0, len(arr)), [rng.choice("abcdef")])
+            elif op < 0.5:
+                nm = Y.YMap()
+                arr.insert(rng.randint(0, len(arr)), [nm])
+                nm.set("born", rnd)
+            elif op < 0.6 and len(arr):
+                pos = rng.randrange(len(arr))
+                arr.delete(pos, 1)
+            elif op < 0.75:
+                # edit a nested map that lives at some array index: the
+                # event path is ["list", <item-count index>]
+                for i in range(len(arr)):
+                    v = arr.get(i)
+                    if hasattr(v, "set"):
+                        v.set(rng.choice("pq"), rnd)
+                        break
+                else:
+                    arr.insert(0, [rng.randint(0, 9)])
+            elif op < 0.85:
+                t = Y.YXmlText()
+                xml.insert(rng.randint(0, xml.length), [t])
+                t.insert(0, rng.choice(["hi", "yo"]))
+            else:
+                # edit an existing xml text child -> path ["xml", index]
+                n = xml._first_child() if hasattr(xml, "_first_child") else None
+                edited = False
+                for i in range(xml.length):
+                    c = xml.get(i)
+                    if isinstance(c, Y.YXmlText):
+                        c.insert(len(c.to_string()), "!")
+                        edited = True
+                        break
+                if not edited:
+                    xml.insert(0, [Y.YXmlText()])
+            updates.append(Y.encode_state_as_update(d, sv))
+        if rng.random() < 0.5:
+            ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+            ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+            Y.apply_update(b, ua)
+            Y.apply_update(a, ub)
+    del nested_keys
+    return updates
+
+
+def _norm_types(events):
+    """norm() with nested-type delta inserts compared by KIND: the engine
+    materializes unbound shells for nested types while the CPU yields the
+    live instances, so identity can never match (same convention as
+    _old_repr for map values)."""
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        delta = []
+        for op in ev.get("delta", []):
+            if isinstance(op.get("insert"), list):
+                op = dict(op)
+                op["insert"] = [
+                    type(v).__name__
+                    if hasattr(v, "to_json") and not isinstance(v, (str, bytes))
+                    else v
+                    for v in op["insert"]
+                ]
+            delta.append(op)
+        ev["delta"] = delta
+        out.append(ev)
+    return norm(out)
+
+
+def test_event_path_parity_nested_lists(rng):
+    """CPU-vs-engine path equality for nested types in arrays/xml under
+    merge-sensitive traffic (the r4 documented divergence, now fixed by
+    counting CPU-merged-item runs in ops/events._path_of)."""
+    updates = _nested_list_session(rng)
+    cpu = Y.Doc(gc=False)
+    eng = BatchEngine(1)
+    got: list = []
+    eng.observe(0, lambda doc, evs: got.extend(evs))
+    for u in updates:
+        expect = cpu_events_for(cpu, u)
+        got.clear()
+        eng.queue_update(0, u)
+        eng.flush()
+        assert _norm_types(got) == _norm_types(expect), "event paths diverged"
+
+
+def test_event_path_parity_after_compaction(rng):
+    """Same parity with a 4-row compaction threshold: compacted mirrors
+    merge rows themselves, so the run-grouping must stay consistent."""
+    updates = _nested_list_session(rng, n_rounds=20)
+    cpu = Y.Doc(gc=False)
+    eng = BatchEngine(1, gc=False, compact_min_rows=4)
+    got: list = []
+    eng.observe(0, lambda doc, evs: got.extend(evs))
+    for u in updates:
+        expect = cpu_events_for(cpu, u)
+        got.clear()
+        eng.queue_update(0, u)
+        eng.flush()
+        assert _norm_types(got) == _norm_types(expect), "event paths diverged post-compaction"

@@ -24,6 +24,7 @@ from .coding import (  # noqa: F401
 )
 from .core import (  # noqa: F401
     GC,
+    AbstractStruct,
     ContentAny,
     ContentBinary,
     ContentDeleted,
@@ -40,6 +41,7 @@ from .core import (  # noqa: F401
     StructStore,
     Transaction,
     add_to_delete_set,
+    create_delete_set,
     create_delete_set_from_struct_store,
     find_index_ss,
     generate_new_client_id,
@@ -108,6 +110,8 @@ from .utils.relative_position import (  # noqa: F401
     create_relative_position_from_type_index,
     decode_relative_position,
     encode_relative_position,
+    read_relative_position,
+    write_relative_position,
 )
 from .utils.snapshot import (  # noqa: F401
     Snapshot,
@@ -126,9 +130,19 @@ from .utils.undo import UndoManager  # noqa: F401
 
 __version__ = "0.1.0"
 
-# -- camelCase aliases (JS API parity) --------------------------------------
+# -- camelCase + JS-name aliases (reference src/index.js:2-76 contract) -----
+# pinned by tests/test_exports.py against the reference export list
+Array = YArray
+Map = YMap
+Text = YText
+XmlText = YXmlText
+XmlHook = YXmlHook
+XmlElement = YXmlElement
+XmlFragment = YXmlFragment
 applyUpdate = apply_update
 applyUpdateV2 = apply_update_v2
+readUpdate = read_update
+readUpdateV2 = read_update_v2
 encodeStateAsUpdate = encode_state_as_update
 encodeStateAsUpdateV2 = encode_state_as_update_v2
 encodeStateVector = encode_state_vector
@@ -141,3 +155,33 @@ diffUpdate = diff_update
 diffUpdateV2 = diff_update_v2
 createDocFromSnapshot = create_doc_from_snapshot
 cleanupYTextFormatting = cleanup_ytext_formatting
+getTypeChildren = get_type_children
+createRelativePositionFromTypeIndex = create_relative_position_from_type_index
+createRelativePositionFromJSON = create_relative_position_from_json
+createAbsolutePositionFromRelativePosition = (
+    create_absolute_position_from_relative_position
+)
+compareRelativePositions = compare_relative_positions
+writeRelativePosition = write_relative_position
+readRelativePosition = read_relative_position
+createID = create_id
+compareIDs = compare_ids
+getState = get_state
+createSnapshot = create_snapshot
+createDeleteSet = create_delete_set
+createDeleteSetFromStructStore = create_delete_set_from_struct_store
+emptySnapshot = empty_snapshot
+findRootTypeKey = find_root_type_key
+getItem = get_item
+typeListToArraySnapshot = type_list_to_array_snapshot
+typeMapGetSnapshot = type_map_get_snapshot
+iterateDeletedStructs = iterate_deleted_structs
+decodeSnapshot = decode_snapshot
+encodeSnapshot = encode_snapshot
+decodeSnapshotV2 = decode_snapshot_v2
+encodeSnapshotV2 = encode_snapshot_v2
+isDeleted = is_deleted
+isParentOf = is_parent_of
+equalSnapshots = equal_snapshots
+tryGc = try_gc
+logType = log_type

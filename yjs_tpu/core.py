@@ -76,7 +76,30 @@ def call_event_handler_listeners(handler: EventHandler, arg0, arg1) -> None:
 GC_STRUCT_REF = 0
 
 
-class GC:
+class AbstractStruct:
+    """Struct contract shared by :class:`GC` and :class:`Item` (reference
+    src/structs/AbstractStruct.js:10-45).  The concrete structs implement
+    the whole surface themselves (``id``/``length``/``deleted``,
+    ``merge_with``, ``integrate``, ``write``, ``get_missing``) — this base
+    is the exported contract (reference src/index.js:17), carrying no
+    state (``__slots__ = ()``) so it costs nothing at runtime."""
+
+    __slots__ = ()
+
+    def merge_with(self, right) -> bool:  # pragma: no cover - contract
+        raise NotImplementedError
+
+    def integrate(self, transaction, offset: int) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def write(self, encoder, offset: int) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def get_missing(self, transaction, store):  # pragma: no cover
+        raise NotImplementedError
+
+
+class GC(AbstractStruct):
     """Length-only tombstone struct; always deleted, merges unconditionally."""
 
     __slots__ = ("id", "length")
@@ -608,7 +631,7 @@ def read_item_content(decoder, info: int):
 # ---------------------------------------------------------------------------
 
 
-class Item:
+class Item(AbstractStruct):
     """THE core struct: a run of content with YATA integration pointers.
 
     ``info`` bitfield: BIT1 keep, BIT2 countable, BIT3 deleted, BIT4 marker.
@@ -1278,6 +1301,12 @@ def merge_delete_sets(dss: list[DeleteSet]) -> DeleteSet:
 
 def add_to_delete_set(ds: DeleteSet, client: int, clock: int, length: int) -> None:
     ds.clients.setdefault(client, []).append(DeleteItem(clock, length))
+
+
+def create_delete_set() -> DeleteSet:
+    """Fresh empty DeleteSet (reference src/utils/DeleteSet.js
+    createDeleteSet, exported from src/index.js:42)."""
+    return DeleteSet()
 
 
 def create_delete_set_from_struct_store(ss: StructStore) -> DeleteSet:

@@ -828,6 +828,12 @@ class DocMirror:
             self.state[slot] = end
         return row
 
+    def content_gen(self) -> int:
+        """Monotonic change counter: bumps on EVERY integrated mutation
+        (inserts, deletes, splits, compaction) — the cache key for
+        derived views like provider.RoomUserData."""
+        return self._gen
+
     def _frag_containing(self, slot: int, clock: int) -> int | None:
         """Index into the fragment lists of the fragment covering ``clock``."""
         fc = self.frag_clock[slot]
@@ -1266,6 +1272,11 @@ class DocMirror:
         plan.link_vals = [self.list_next[r] for r in plan.link_rows]
         plan.head_segs = sorted(plan._dh)
         plan.head_vals = [self.head_of_seg[s] for s in plan.head_segs]
+        # every prepare bumps the change counter even when no row was
+        # appended (delete-only flushes) — the C++ twin does the same at
+        # the end of Mirror::prepare, and content_gen() consumers rely
+        # on it to see delete-only changes
+        self._gen += 1
         return plan
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:

@@ -284,13 +284,12 @@ class BatchEngine:
         YEvent.path + YEvent.changes).  Demoted docs deliver the same
         shape from the CPU core's transactions.
 
-        Path note (deliberate divergence): numeric list positions in
-        ``path`` are COUNTABLE-LENGTH indices — what ``get(index)``
-        addresses — not the reference getPathTo's undeleted-item counts
-        (YEvent.js:207-228), which shift with run-merge state.  Code
-        comparing paths against upstream Yjs event paths may see
-        different numeric indices for list children (see
-        ops/events.py _path_of)."""
+        Numeric list positions in ``path`` match the reference getPathTo
+        (YEvent.js:207-228) exactly: one per undeleted ITEM before the
+        target, with mirror rows grouped into CPU-merged-item runs so the
+        count equals what a CPU doc reports even though the mirror merges
+        lazily (ops/events.py _path_of / _rows_one_cpu_item; parity
+        pinned by test_engine_events.py::test_event_path_parity_*)."""
         self._event_listeners.setdefault(doc, []).append(callback)
         fb = self.fallback.get(doc)
         if fb is not None:
@@ -950,7 +949,10 @@ class BatchEngine:
                 oob_r, oob_s, int(NULL), lane_dtype,
             )
             stats_tot += stats
-            lanes_padded_tot += k_dn + k_sp + k_h + k_d
+            # capacity is per shard; real lane counts (stats) sum across
+            # shards, so the denominator must too or meshed runs report
+            # occupancy inflated by n_shards (ADVICE r4)
+            lanes_padded_tot += n_shards * (k_dn + k_sp + k_h + k_d)
             # the apply path never reads the device statics; mark touched
             # docs for full (re-)upload if a levels/seq flush ever runs
             for i, _, _ in chunk_ok:
@@ -1112,7 +1114,9 @@ class BatchEngine:
             self._emit_phase(plans, pre_svs, emitting)
         t_emit = time.perf_counter()
 
-        lanes_padded = k_dn + k_sp + k_h + k_d
+        # real lane counts sum across shards; scale the per-shard capacity
+        # to match (ADVICE r4: meshed occupancy was inflated by n_shards)
+        lanes_padded = len(lanes) * (k_dn + k_sp + k_h + k_d)
         lanes_real = n_dense + n_sparse + n_heads + n_dels
         pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
         metrics.update({
@@ -1272,6 +1276,128 @@ class BatchEngine:
         return self._delta_of_seg_snapshot(
             doc, seg, snapshot, prev_snapshot, compute_ychange
         )
+
+    # -- relative positions (cursors) from mirror columns -------------------
+
+    def relative_position_from_index(self, doc: int, index: int,
+                                     name: str | None = None):
+        """Stable cursor for one root type of a device-resident room,
+        computed from mirror columns alone — no CPU-doc materialization,
+        no device round trip (reference RelativePosition.js:85-104
+        createRelativePositionFromTypeIndex).  Returns a standard
+        :class:`~yjs_tpu.utils.relative_position.RelativePosition`
+        (encode/decode/JSON interop with JS peers applies)."""
+        from ..ids import create_id
+        from ..utils.relative_position import (
+            RelativePosition,
+            create_relative_position_from_type_index,
+        )
+
+        name = name or self.root_name
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            return create_relative_position_from_type_index(
+                fb.get_text(name), index
+            )
+        m = self.mirrors[doc]
+        seg = m.segments.get((name, None, NULL))
+        if seg is not None:
+            rows, dels = self._order(doc, seg)
+            for r, d in zip(rows, dels):
+                r = int(r)
+                if d or not m.row_countable[r]:
+                    continue
+                ln = int(m.row_len[r])
+                if ln > index:
+                    client = m.client_of_slot[int(m.row_slot[r])]
+                    return RelativePosition(
+                        None, name, create_id(client, int(m.row_clock[r]) + index)
+                    )
+                index -= ln
+        return RelativePosition(None, name, None)
+
+    def _row_of_id(self, m, client: int, clock: int) -> int | None:
+        """Row containing (client, clock) via the mirror fragment index,
+        or None when that clock is not integrated yet (reference
+        getItem/findIndexSS semantics against columnar state)."""
+        slot = m.slot_of_client.get(client)
+        if slot is None or m.state[slot] <= clock:
+            return None
+        fi = m._frag_containing(slot, clock)
+        return None if fi is None else int(m.frag_row[slot][fi])
+
+    def absolute_index_from_relative(self, doc: int, rpos) -> int | None:
+        """Resolve a cursor back to a list index against the room's
+        CURRENT state, from mirror columns alone (reference
+        RelativePosition.js:214-262
+        createAbsolutePositionFromRelativePosition).  Returns None when
+        the anchor is unknown (not yet integrated / garbage collected),
+        exactly like the reference.
+
+        Deviation (documented): the return value is the index alone —
+        on the engine path the type handle is the (doc, root-name) pair
+        the caller already holds, not a live Y type object.  ``redone``
+        chains are a CPU-replica concept (the pointers are local to the
+        undoing replica and never on the wire), so the mirror path has
+        none to follow; rooms with server-side undo enabled resolve
+        through their replica instead (see TpuProvider
+        .resolve_relative_position), which runs the reference
+        follow-redone walk verbatim."""
+        from ..utils.relative_position import (
+            create_absolute_position_from_relative_position,
+        )
+
+        fb = self.fallback.get(doc)
+        if fb is not None:
+            a = create_absolute_position_from_relative_position(rpos, fb)
+            return None if a is None else a.index
+        m = self.mirrors[doc]
+
+        def visible_len(seg: int) -> int:
+            rows, dels = self._order(doc, seg)
+            tot = 0
+            for r, d in zip(rows, dels):
+                r = int(r)
+                if not d and m.row_countable[r]:
+                    tot += int(m.row_len[r])
+            return tot
+
+        if rpos.item is not None:
+            r = self._row_of_id(m, rpos.item.client, rpos.item.clock)
+            if r is None or m.row_is_gc[r]:
+                # unknown clock or GC'd anchor: reference returns null
+                # (followRedone landed on a GC struct)
+                return None
+            seg = int(m.row_seg[r])
+            _name, _sub, parent = m.seg_info[seg]
+            if parent != NULL and parent in m._host_deleted_rows:
+                # parent type deleted: reference keeps index 0
+                return 0
+            deleted = r in m._host_deleted_rows
+            index = (
+                0
+                if (deleted or not m.row_countable[r])
+                else rpos.item.clock - int(m.row_clock[r])
+            )
+            rows, dels = self._order(doc, seg)
+            for rr, dd in zip(rows, dels):
+                rr = int(rr)
+                if rr == r:
+                    return index
+                if not dd and m.row_countable[rr]:
+                    index += int(m.row_len[rr])
+            return None  # anchor row not reachable in its segment
+        if rpos.tname is not None:
+            seg = m.segments.get((rpos.tname, None, NULL))
+            # absent root = empty type (reference doc.get(tname)._length)
+            return 0 if seg is None else visible_len(seg)
+        if rpos.type is not None:
+            r = self._row_of_id(m, rpos.type.client, rpos.type.clock)
+            if r is None or m.row_is_gc[r] or int(m.row_content_ref[r]) != 7:
+                return None
+            seg = m.segments.get((None, None, r))
+            return 0 if seg is None else visible_len(seg)
+        raise ValueError("invalid relative position")
 
     def snapshot(self, doc: int):
         """Point-in-time capture (state vector + delete set) of one room,

@@ -192,15 +192,56 @@ def compute_flush_events(mirror, plan, pre_state: dict[int, int]):
     return events
 
 
-def _path_of(mirror, name, parent_row) -> list:
-    """Root-to-type path: map keys as strings, list positions as the
-    preceding countable length (the user-visible index).
+# content refs whose CPU classes merge (Item.mergeWith succeeds:
+# ContentDeleted/JSON/String/Any — core.py merge_with returns True)
+_MERGEABLE_REFS = frozenset((1, 2, 4, 8))
 
-    Deliberate divergence from the reference's getPathTo
-    (YEvent.js:207-228), which counts undeleted ITEMS — an index that
-    shifts with run-merge state (two adjacent inserts count 2 before the
-    transaction-cleanup merge, 1 after).  The countable-length index is
-    merge-invariant and equals what get(index) addresses."""
+
+def _rows_one_cpu_item(mirror, p: int, r: int) -> bool:
+    """True when list-adjacent mirror rows p,r are ONE Item in the CPU
+    store — the exact Item.mergeWith predicate (core.py:862-884 /
+    reference Item.js:555-579) evaluated over columns: same client,
+    consecutive clocks, r's origin = p's last id, equal right origins,
+    equal deleted state, mergeable equal content kinds.  The CPU doc
+    merges every such adjacent pair during transaction cleanup, while
+    the mirror keeps rows split until compaction — this predicate is
+    what keeps the two path indexings identical."""
+    if int(mirror.row_slot[p]) != int(mirror.row_slot[r]):
+        return False
+    if int(mirror.row_clock[p]) + int(mirror.row_len[p]) != int(
+        mirror.row_clock[r]
+    ):
+        return False
+    ref = int(mirror.row_content_ref[r])
+    if ref != int(mirror.row_content_ref[p]) or ref not in _MERGEABLE_REFS:
+        return False
+    # r.origin == p.last_id
+    if (
+        int(mirror.row_origin_slot[r]) != int(mirror.row_slot[p])
+        or int(mirror.row_origin_clock[r])
+        != int(mirror.row_clock[p]) + int(mirror.row_len[p]) - 1
+    ):
+        return False
+    # equal right origins
+    rs_p, rs_r = int(mirror.row_right_slot[p]), int(mirror.row_right_slot[r])
+    if rs_p != rs_r:
+        return False
+    if rs_p != NULL and int(mirror.row_right_clock[p]) != int(
+        mirror.row_right_clock[r]
+    ):
+        return False
+    host_deleted = mirror._host_deleted_rows
+    return (p in host_deleted) == (r in host_deleted)
+
+
+def _path_of(mirror, name, parent_row) -> list:
+    """Root-to-type path: map keys as strings, list positions counted
+    exactly like the reference's getPathTo (YEvent.js:207-228): one per
+    undeleted ITEM before the target.  The mirror keeps runs split that
+    the CPU store has merged (cleanup merges eagerly, the mirror only at
+    compaction), so consecutive rows forming one CPU item
+    (_rows_one_cpu_item) count once — pinned against the CPU path by
+    tests/test_engine_events.py::test_event_path_parity_*."""
     path: list = []
     host_deleted = mirror._host_deleted_rows
     while parent_row != NULL:
@@ -212,10 +253,15 @@ def _path_of(mirror, name, parent_row) -> list:
         else:
             i = 0
             c = mirror.head_of_seg[sg]
+            prev = None  # previous row in LIST order (deleted included:
+            # a deleted run between two live runs breaks CPU adjacency)
             while c != NULL and int(c) != r:
                 c = int(c)
-                if c not in host_deleted and mirror.row_countable[c]:
-                    i += int(mirror.row_len[c])
+                if c not in host_deleted and not (
+                    prev is not None and _rows_one_cpu_item(mirror, prev, c)
+                ):
+                    i += 1
+                prev = c
                 c = mirror.list_next[c]
             path.insert(0, i)
         name, parent_row = pname, pparent

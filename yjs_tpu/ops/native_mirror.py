@@ -284,6 +284,12 @@ class NativeMirror:
         self._finish_prepare(rc, staged, ids, counts)
         return NativePlan(lib, h, counts, self)
 
+    def content_gen(self) -> int:
+        """Monotonic change counter (the C++ core's ``gen``): bumps on
+        every integrated mutation AND at the end of every prepare, so
+        delete-only flushes are visible to cached consumers."""
+        return int(self._lib.ymx_gen(self._h))
+
     @property
     def n_rows(self) -> int:
         return int(self._lib.ymx_n_rows(self._h))

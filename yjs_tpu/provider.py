@@ -87,10 +87,11 @@ class TpuProvider:
         "what changed in room X" seam without replaying into a CPU doc.
         Returns an unsubscribe callable.
 
-        Numeric list positions in ``path`` are merge-invariant
-        countable-length indices (what ``get(index)`` addresses), NOT the
-        reference getPathTo's undeleted-item counts — see
-        BatchEngine.observe for the full divergence note."""
+        Numeric list positions in ``path`` match the reference getPathTo
+        (YEvent.js:207-228): one per undeleted item before the target,
+        with mirror rows grouped into CPU-merged-item runs so the count
+        equals what a CPU doc reports (ops/events._path_of; parity pinned
+        by tests/test_engine_events.py::test_event_path_parity_*)."""
         prefix = list(path)
 
         def bridge(doc, events, g=guid):
@@ -142,7 +143,11 @@ class TpuProvider:
             tuple(scopes) if scopes is not None
             else (("text", self.engine.root_name),)
         )
-        settings = (norm_scopes, capture_timeout, delete_filter)
+        # idempotency compares scopes/capture_timeout only: callables have
+        # no useful equality (a lambda re-created at each call site would
+        # spuriously fail an identity check), so a repeat call may pass any
+        # delete_filter — the one from the first call stays in effect
+        settings = (norm_scopes, capture_timeout)
         if guid in self._undo:
             if self._undo_settings[guid] != settings:
                 raise ValueError(
@@ -316,6 +321,64 @@ class TpuProvider:
             self.doc_id(guid), snap, new_doc
         )
 
+    # -- user attribution (PermanentUserData queries) -----------------------
+
+    def user_data(self, guid: str, store_name: str = "users"):
+        """Attribution view over the room's PermanentUserData map
+        (reference src/utils/PermanentUserData.js:15-142), served from
+        mirror columns — the room stays device-resident.
+
+        Deployment model (same as the reference's): editing CLIENTS call
+        setUserMapping on their own docs, so the ``users`` map arrives as
+        ordinary update traffic and the mirror hosts it like any root
+        type.  The server answers ``user_by_client_id`` /
+        ``user_by_deleted_id`` by reading the map straight out of the
+        mirror (ids arrays, encoded-DeleteSet blobs) — no CPU doc, no
+        observers, no replica."""
+        return RoomUserData(self, guid, store_name)
+
+    # -- cursors (relative positions) ---------------------------------------
+
+    def create_relative_position(self, guid: str, index: int,
+                                 name: str | None = None):
+        """Stable cursor at ``index`` of the room's root type ``name``
+        (reference createRelativePositionFromTypeIndex,
+        RelativePosition.js:85-104), computed from the device-resident
+        room's mirror columns — no CPU-doc materialization per keystroke.
+        The result is wire/JSON compatible with JS peers
+        (encode_relative_position / to_json)."""
+        self.flush()
+        return self.engine.relative_position_from_index(
+            self.doc_id(guid), index, name
+        )
+
+    def resolve_relative_position(self, guid: str, rpos) -> int | None:
+        """Resolve a cursor to the current index (reference
+        createAbsolutePositionFromRelativePosition,
+        RelativePosition.js:214-262).  None = anchor unknown/GC'd.
+
+        Rooms with server-side undo enabled resolve through their CPU
+        replica, which runs the reference follow-redone walk verbatim —
+        cursors anchored in undone-then-redone content land on the
+        redone items.  ``redone`` pointers exist ONLY where an
+        UndoManager performed the redo (they are never on the wire), so
+        rooms without undo have no chains to follow and resolve straight
+        from mirror columns."""
+        from .utils.relative_position import (
+            create_absolute_position_from_relative_position,
+        )
+
+        self.flush()
+        ru = self._undo.get(guid)
+        if ru is not None:
+            a = create_absolute_position_from_relative_position(
+                rpos, ru.replica
+            )
+            return None if a is None else a.index
+        return self.engine.absolute_index_from_relative(
+            self.doc_id(guid), rpos
+        )
+
     def xml_string(self, guid: str) -> str:
         """XML serialization of the room's root fragment (reference
         YXmlFragment.toString) — served from the mirror."""
@@ -387,3 +450,99 @@ class RoomUndoHandle:
         """The underlying reference UndoManager (event subscription —
         stack-item-added / stack-item-popped)."""
         return self._provider._room_undo(self._guid).manager
+
+
+class RoomUserData:
+    """Read-side twin of the reference PermanentUserData
+    (PermanentUserData.js:15-142) for a device-resident room: the
+    ``users`` map — ``{description: {"ids": [clientid...],
+    "ds": [encoded DeleteSet...]}}``, written by editing clients with
+    setUserMapping — is read from mirror columns on demand.
+
+    The parse is cached against the mirror's change counter
+    (``content_gen``), which bumps on every integrated mutation —
+    delete-only updates and compaction included.
+
+    Deviation (documented): the reference PermanentUserData accumulates
+    mappings in observer-fed dicts and never forgets them, so a deleted
+    users-map entry still resolves there; this view reads the CURRENT
+    map, so deleting a user's entry removes the attribution.  Reading
+    live state is the defensible server behavior (the reference marks
+    PermanentUserData @experimental); the difference is pinned in
+    tests/test_permanent_user_data.py."""
+
+    __slots__ = ("_provider", "_guid", "_store", "_gen_seen", "_clients",
+                 "_dss")
+
+    def __init__(self, provider: TpuProvider, guid: str, store_name: str):
+        self._provider = provider
+        self._guid = guid
+        self._store = store_name
+        self._gen_seen = -1
+        self._clients: dict[int, str] = {}
+        self._dss: dict = {}
+
+    def _refresh(self) -> None:
+        from .coding import DSDecoderV1
+        from .core import DeleteSet, merge_delete_sets, read_delete_set
+        from .lib0.decoding import Decoder
+
+        prov = self._provider
+        prov.flush()
+        i = prov.doc_id(self._guid)
+        eng = prov.engine
+        fb = eng.fallback.get(i)
+        if fb is None:
+            gen = eng.mirrors[i].content_gen()
+            if gen == self._gen_seen:
+                return
+        else:
+            # demoted room: no cheap change counter — always reparse
+            gen = -1
+        users = (
+            fb.get_map(self._store).to_json()
+            if fb is not None
+            else eng.map_json(i, self._store)
+        )
+        clients: dict[int, str] = {}
+        dss: dict = {}
+        for desc, rec in users.items():
+            if not isinstance(rec, dict):
+                continue
+            for cid in rec.get("ids") or []:
+                if isinstance(cid, int):
+                    clients[cid] = desc
+            sets = [
+                read_delete_set(DSDecoderV1(Decoder(bytes(b))))
+                for b in rec.get("ds") or []
+                if isinstance(b, (bytes, bytearray))
+            ]
+            dss[desc] = merge_delete_sets(sets) if sets else DeleteSet()
+        self._clients = clients
+        self._dss = dss
+        self._gen_seen = gen
+
+    def user_by_client_id(self, clientid: int) -> str | None:
+        """reference getUserByClientId (PermanentUserData.js:126-128)."""
+        self._refresh()
+        return self._clients.get(clientid)
+
+    def user_by_deleted_id(self, id) -> str | None:
+        """reference getUserByDeletedId (PermanentUserData.js:134-141)."""
+        from .core import is_deleted
+
+        self._refresh()
+        for desc, ds in self._dss.items():
+            if is_deleted(ds, id):
+                return desc
+        return None
+
+    @property
+    def clients(self) -> dict[int, str]:
+        self._refresh()
+        return dict(self._clients)
+
+    @property
+    def dss(self) -> dict:
+        self._refresh()
+        return dict(self._dss)
