@@ -50,27 +50,37 @@ import numpy as np
 NODE_PROXY_FACTOR = float(os.environ.get("YTPU_NODE_PROXY_FACTOR", "20"))
 
 
-def gen_trace(n_ops: int, seed: int = 7):
-    """Two clients, typing bursts + deletes + periodic sync; returns the
-    final merged update and the reference doc."""
+def gen_trace(n_ops: int, seed: int = 7, n_clients: int = 2,
+              sync_p: float = 0.3):
+    """Concurrent editing trace: ``n_clients`` clients, typing bursts +
+    deletes + periodic full syncs (probability ``sync_p`` per burst).
+    The default (2 clients, 0.3) is the classic distinct-doc texture; the
+    conflict-storm shape uses 4 clients with rare syncs, so long
+    concurrent runs collide at the same positions (deep YATA conflict
+    scans, heavy pre-splitting).  Returns (merged update, reference doc)."""
     import yjs_tpu as Y
 
     gen = random.Random(seed)
-    a = Y.Doc(gc=False)
-    a.client_id = 101
-    b = Y.Doc(gc=False)
-    b.client_id = 202
+    docs = []
+    for k in range(n_clients):
+        d = Y.Doc(gc=False)
+        d.client_id = 101 * (k + 1)
+        docs.append(d)
     words = ["the ", "quick ", "brown ", "fox ", "jumps ", "over ", "lazy ", "dog . "]
 
     def sync():
-        ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
-        ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
-        Y.apply_update(b, ua)
-        Y.apply_update(a, ub)
+        for da in docs:
+            for db in docs:
+                if da is db:
+                    continue
+                u = Y.encode_state_as_update(da, Y.encode_state_vector(db))
+                Y.apply_update(db, u)
 
     ops = 0
     while ops < n_ops:
-        d = a if gen.random() < 0.5 else b
+        # one gen.random() draw (for n_clients=2 this reproduces the r2-r4
+        # fixture generator's RNG stream exactly: int(r*2)==0 <=> r<0.5)
+        d = docs[min(n_clients - 1, int(gen.random() * n_clients))]
         t = d.get_text("text")
         cursor = gen.randint(0, len(t))
         burst = gen.randint(3, 12)
@@ -86,11 +96,29 @@ def gen_trace(n_ops: int, seed: int = 7):
                 t.delete(pos, n)
                 cursor = min(cursor, len(t))
             ops += 1
-        if gen.random() < 0.3:
+        if gen.random() < sync_p:
             sync()
     sync()
-    assert a.get_text("text").to_string() == b.get_text("text").to_string()
-    return Y.encode_state_as_update(a), a
+    ref = docs[0].get_text("text").to_string()
+    for d in docs[1:]:
+        assert d.get_text("text").to_string() == ref
+    return Y.encode_state_as_update(docs[0]), docs[0]
+
+
+def gen_prepend_fragmented(n_chars: int, seed: int = 3):
+    """The reference's own worst-case perf probe (y-text.tests.js:297-324):
+    N single-char inserts all at position 0.  No two items can ever merge
+    (each prepended item has a null origin), so the doc is one item per
+    character — maximal struct count per content byte."""
+    import yjs_tpu as Y
+
+    gen = random.Random(seed)
+    d = Y.Doc(gc=False)
+    d.client_id = 77
+    t = d.get_text("text")
+    for _ in range(n_chars):
+        t.insert(0, chr(gen.randint(97, 122)))
+    return Y.encode_state_as_update(d), d
 
 
 def cpu_apply_rate(update: bytes, repeats: int = 1) -> tuple[float, int]:
@@ -252,15 +280,24 @@ def bench_b4_broadcast(n_docs: int) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def load_distinct_traces(n_docs: int, n_ops: int) -> list[bytes]:
-    """Pre-generated distinct traces (scripts/gen_distinct_fixtures.py);
-    falls back to in-process synthesis when the fixture is missing."""
+def load_distinct_traces(
+    n_docs: int, n_ops: int, kind: str = "distinct"
+) -> list[bytes]:
+    """Pre-generated traces (scripts/gen_distinct_fixtures.py; ``kind`` =
+    "distinct" two-client or "storm" four-client); falls back to
+    in-process synthesis when the fixture is missing.
+
+    When ``n_docs`` exceeds the fixture, traces repeat cyclically: every
+    doc still gets its own mirror/plan/transfer (per-doc host cost is
+    trace-content-independent), so scaling sweeps measure the framework,
+    not the fixture generator."""
     import struct
     import zlib
 
+    stem = "distinct_traces" if kind == "distinct" else "storm_traces"
     path = (
         Path(__file__).resolve().parent
-        / "tests" / "fixtures" / f"distinct_traces_{n_ops}.bin"
+        / "tests" / "fixtures" / f"{stem}_{n_ops}.bin"
     )
     zpath = path.with_suffix(".bin.z")
     if path.exists() or zpath.exists():
@@ -276,22 +313,36 @@ def load_distinct_traces(n_docs: int, n_ops: int) -> list[bytes]:
             (ln,) = struct.unpack_from("<I", raw, o)
             out.append(raw[o + 4 : o + 4 + ln])
             o += 4 + ln
-        if len(out) >= n_docs:
-            return out
-    return [gen_trace(n_ops, seed=1000 + i)[0] for i in range(n_docs)]
+        if out:
+            return [out[i % len(out)] for i in range(n_docs)]
+    n_clients, sync_p = (2, 0.3) if kind == "distinct" else (4, 0.08)
+    base = [
+        gen_trace(n_ops, seed=1000 + i, n_clients=n_clients, sync_p=sync_p)[0]
+        for i in range(min(n_docs, 64))
+    ]
+    return [base[i % len(base)] for i in range(n_docs)]
 
 
-def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
+def bench_distinct(
+    n_docs: int, n_ops: int, kind: str = "distinct", runs: int = 3
+) -> tuple[dict, object]:
     from yjs_tpu.ops import BatchEngine
 
     # workload acquisition (per-doc distinct traces) — NOT timed: this
     # stands in for network receive, not for framework work
-    updates = load_distinct_traces(n_docs, n_ops)
+    updates = load_distinct_traces(n_docs, n_ops, kind=kind)
+    # CPU oracle rate per UNIQUE trace (cyclic fixtures repeat bytes; the
+    # engine cost per doc is identical either way)
     cpu_elems, cpu_time = 0, 0.0
+    unique: dict[bytes, tuple[float, int]] = {}
     for u in updates:
-        rate, n_el = cpu_apply_rate(u)
+        if u not in unique:
+            rate, n_el = cpu_apply_rate(u)
+            unique[u] = (n_el / rate if rate else 0.0, n_el)
+        t_u, n_el = unique[u]
         cpu_elems += n_el
-        cpu_time += n_el / rate if rate else 0.0
+        cpu_time += t_u
+    del unique
 
     # compile warmup: an identically-shaped engine run (fresh engine, same
     # updates -> same padded bucket shapes -> compile cache hit in the timed
@@ -314,12 +365,12 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
     gc.collect()
     gc.freeze()
 
-    # median of 3 timed runs: host-core and tunnel contention swing
+    # median of ``runs`` timed runs: host-core and tunnel contention swing
     # single runs 2-4x (BASELINE.md), and the server shape is steady-state.
     # ONE engine alive at a time (a server holds one engine; stacking
     # 200MB+ mirror states from prior runs thrashes the single host core)
-    runs = []  # (dt, flush metrics) pairs; sorted by dt for the median
-    for _ in range(3):
+    timed = []  # (dt, flush metrics) pairs; sorted by dt for the median
+    for _ in range(runs):
         # free the previous engine and let the device-side buffer deletes
         # drain BEFORE the timed window (cleanup RPCs otherwise steal the
         # single host core mid-run and inflate plan timers 2-3x)
@@ -334,10 +385,10 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
         # readback barrier: force device completion
         np.asarray(eng._right[:, 0])
         dt = time.perf_counter() - t0
-        runs.append((dt, eng.last_flush_metrics))
+        timed.append((dt, eng.last_flush_metrics))
     gc.unfreeze()
-    runs.sort(key=lambda p: p[0])
-    t_e2e, eng_metrics = runs[1]  # median run (its own metrics)
+    timed.sort(key=lambda p: p[0])
+    t_e2e, eng_metrics = timed[len(timed) // 2]  # median run (its metrics)
 
     # convergence spot-check on 3 docs (distinct traces -> meaningful)
     import yjs_tpu as Y
@@ -368,6 +419,7 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
                 m.get("t_plan_s", 0.0) / max(1, n_docs) * 1e3, 3
             ),
             "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
+            "plan_threads": m.get("plan_threads", 1),
             "n_demoted": m.get("n_demoted", 0),
         },
         eng,
@@ -375,26 +427,136 @@ def bench_distinct(n_docs: int, n_ops: int) -> tuple[dict, object]:
 
 
 # ---------------------------------------------------------------------------
+# Adversarial shapes (VERDICT r4 item 8)
+# ---------------------------------------------------------------------------
+
+
+def bench_fragmented(n_docs: int, n_chars: int) -> dict:
+    """The reference's worst-case perf probe at batch scale: every doc is
+    a maximally fragmented prepend-built text (one item per character,
+    y-text.tests.js:297-324), replicated across ``n_docs`` mirrors.
+    Reports planner ms/doc and occupancy under the nastiest struct-per-
+    byte ratio the reference itself measures."""
+    import gc
+
+    from yjs_tpu.ops import BatchEngine
+
+    update = load_prepend_fixture(n_chars)
+    cpu_rate, n_el = cpu_apply_rate(update)
+    eng = BatchEngine(n_docs)
+    for i in range(n_docs):
+        eng.queue_update(i, update)
+    eng.flush()  # warmup/compile
+    np.asarray(eng._right[:, 0])
+    expect = None
+    import yjs_tpu as Y
+
+    d = Y.Doc(gc=False)
+    Y.apply_update(d, update)
+    expect = d.get_text("text").to_string()
+    if eng.text(0) != expect:
+        print(json.dumps({"metric": "FAILED_fragmented_convergence",
+                          "value": 0, "unit": "", "vs_baseline": 0}))
+        sys.exit(1)
+    eng = None
+    gc.collect()
+    time.sleep(3)
+    eng = BatchEngine(n_docs)
+    t0 = time.perf_counter()
+    for i in range(n_docs):
+        eng.queue_update(i, update)
+    eng.flush()
+    np.asarray(eng._right[:, 0])
+    dt = time.perf_counter() - t0
+    m = eng.last_flush_metrics or {}
+    total = n_docs * n_el
+    res = {
+        "n_docs": n_docs,
+        "chars_per_doc": n_chars,
+        "update_bytes": len(update),
+        "e2e_elems_per_sec": round(total / dt, 1),
+        "cpu_py_elems_per_sec": round(cpu_rate, 1),
+        "t_e2e_s": round(dt, 4),
+        "planner_ms_per_doc": round(
+            m.get("t_plan_s", 0.0) / max(1, n_docs) * 1e3, 3
+        ),
+        "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
+        "n_demoted": m.get("n_demoted", 0),
+    }
+    del eng
+    gc.collect()
+    return res
+
+
+def load_prepend_fixture(n_chars: int) -> bytes:
+    """Pre-generated prepend-fragmented update
+    (scripts/gen_adversarial_fixtures.py); synthesized at a smaller size
+    when the fixture is missing (generation is O(n) CPU-core edits)."""
+    import zlib
+
+    path = (
+        Path(__file__).resolve().parent
+        / "tests" / "fixtures" / f"prepend_frag_{n_chars}.bin.z"
+    )
+    if path.exists():
+        return zlib.decompress(path.read_bytes())
+    return gen_prepend_fragmented(n_chars)[0]
+
+
+# ---------------------------------------------------------------------------
 # Variant 3: batched sync step 2 (state-vector diff) over all distinct docs
 # ---------------------------------------------------------------------------
+
+
+# isolated-measurement band for sync_step2_batched at 1024 docs on this
+# host (BASELINE.md r5): single-window readings below it indicate harness
+# contention (cleanup RPCs / tunnel weather), not a code regression
+_SYNC_BAND = (7300.0, 8700.0)
 
 
 def bench_sync(eng, n_docs: int) -> dict:
     # every doc answers a fresh peer (empty SV -> full-state diff): one
     # diff_mask_kernel dispatch + per-doc native wire encode.  First call
-    # warms the kernel compile (steady-state server measurement).
+    # warms the kernel compile; median of 3 windows (single windows read
+    # up to ~40% low when the distinct loop's cleanup RPCs are still
+    # draining — the r4 "regression" was exactly this, BASELINE.md r5).
     requests = [(i, {}) for i in range(n_docs)]
     eng.sync_step2_batch(requests)
-    t0 = time.perf_counter()
-    replies = eng.sync_step2_batch(requests)
-    dt = time.perf_counter() - t0
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        replies = eng.sync_step2_batch(requests)
+        windows.append(time.perf_counter() - t0)
+    dt = sorted(windows)[1]
     total_bytes = sum(len(r) for r in replies)
-    return {
+    rate = n_docs / dt
+    out = {
         "n_docs": n_docs,
-        "syncs_per_sec": round(n_docs / dt, 1),
+        "syncs_per_sec": round(rate, 1),
         "encoded_mb_per_sec": round(total_bytes / dt / 1e6, 2),
         "t_total_s": round(dt, 4),
     }
+    if n_docs == 1024 and rate < _SYNC_BAND[0] * 0.7:
+        out["band_warning"] = (
+            f"rate {rate:.0f}/s is >30% below the isolated band "
+            f"{_SYNC_BAND} — suspect harness/tunnel contention first "
+            "(see BASELINE.md r5)"
+        )
+    return out
+
+
+def sweep_distinct(n_ops: int, sizes=(1024, 2048, 4096, 8192)) -> list[dict]:
+    """Distinct-doc scaling sweep (VERDICT r4 item 2): per-phase timers at
+    growing doc counts feed the 100k-doc extrapolation in BASELINE.md.
+    Opt-in (YTPU_BENCH_SWEEP=1) — it multiplies the bench runtime."""
+    rows = []
+    for n in sizes:
+        d, eng = bench_distinct(n, n_ops, runs=3)
+        rows.append(d)
+        del eng
+        print(json.dumps({"sweep_row": d}), file=sys.stderr, flush=True)
+        time.sleep(3)
+    return rows
 
 
 def main():
@@ -414,30 +576,71 @@ def main():
     )
     n_ops = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
 
-    b4 = bench_b4_broadcast(n_docs_b4)
+    # the HEADLINE is the distinct-doc engine path: per-doc decode, plan,
+    # pack, transfer, apply — what a production server does per room
+    # (VERDICT r4 item 2: lead with the honest number; the broadcast
+    # fan-out shape stays in detail as the amortized best case)
     distinct, eng = bench_distinct(n_docs_distinct, n_ops)
     # let the timed loop's freed engines finish their device-side buffer
     # deletes before timing sync (cleanup RPCs share the host core)
     time.sleep(3)
     sync = bench_sync(eng, n_docs_distinct)
+    del eng
+    import gc
 
+    gc.collect()
+    time.sleep(3)
+    storm, storm_eng = bench_distinct(
+        int(os.environ.get("YTPU_BENCH_STORM_DOCS", "256")),
+        n_ops, kind="storm", runs=1,
+    )
+    del storm_eng
+    gc.collect()
+    time.sleep(3)
+    frag = bench_fragmented(
+        int(os.environ.get("YTPU_BENCH_FRAG_DOCS", "64")),
+        int(os.environ.get("YTPU_BENCH_FRAG_CHARS", "100000")),
+    )
+    time.sleep(3)
+    b4 = bench_b4_broadcast(n_docs_b4)
+    sweep = (
+        sweep_distinct(n_ops)
+        if os.environ.get("YTPU_BENCH_SWEEP")
+        else None
+    )
+
+    node_proxy_distinct = distinct["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
     node_proxy_b4 = b4["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
-    headline = b4["e2e_elems_per_sec"]
+    headline = distinct["e2e_elems_per_sec"]
     result = {
-        "metric": "b4_replay_e2e_elements_per_sec",
+        "metric": "distinct_docs_e2e_elements_per_sec",
         "value": headline,
         "unit": (
-            f"elem/s end-to-end ({b4['n_docs']} docs x {b4['elems_per_doc']} "
-            f"elems broadcast; incl. host transcode+pack; vs Node PROXY = "
-            f"python_core x{NODE_PROXY_FACTOR:g}, see BASELINE.md)"
+            f"elem/s end-to-end ({distinct['n_docs']} DISTINCT docs x "
+            f"{n_ops}-op traces through the full engine path: decode+plan+"
+            f"pack+transfer+apply; vs Node PROXY = python_core x"
+            f"{NODE_PROXY_FACTOR:g}, see BASELINE.md.  Broadcast fan-out "
+            f"case in detail.b4_broadcast)"
         ),
-        "vs_baseline": round(headline / node_proxy_b4, 2) if node_proxy_b4 else 0,
+        "vs_baseline": (
+            round(headline / node_proxy_distinct, 2)
+            if node_proxy_distinct
+            else 0
+        ),
         "detail": {
-            "b4_broadcast": b4,
             "distinct_engine_path": distinct,
+            "conflict_storm_4client": storm,
+            "prepend_fragmented": frag,
             "sync_step2_batched": sync,
+            "b4_broadcast": b4,
             "node_proxy_factor": NODE_PROXY_FACTOR,
+            "node_proxy_distinct_elems_per_sec": round(node_proxy_distinct, 1),
             "node_proxy_b4_elems_per_sec": round(node_proxy_b4, 1),
+            "b4_broadcast_vs_proxy": (
+                round(b4["e2e_elems_per_sec"] / node_proxy_b4, 2)
+                if node_proxy_b4
+                else 0
+            ),
             "distinct_e2e_vs_python": round(
                 distinct["e2e_elems_per_sec"]
                 / max(1.0, distinct["cpu_py_elems_per_sec"]),
@@ -445,6 +648,8 @@ def main():
             ),
         },
     }
+    if sweep is not None:
+        result["detail"]["distinct_scaling_sweep"] = sweep
     print(json.dumps(result))
 
 

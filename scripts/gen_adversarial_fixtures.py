@@ -1,0 +1,56 @@
+"""Pre-generate the adversarial bench fixtures (bench.py r5 shapes).
+
+- storm_traces_{OPS}.bin.z: N_STORM four-client conflict-storm traces
+  (rare syncs -> long concurrent runs colliding at shared positions:
+  deep YATA conflict scans + heavy pre-splitting), same framing as
+  distinct_traces.
+- prepend_frag_{CHARS}.bin.z: ONE update of a maximally fragmented
+  prepend-built text (reference y-text.tests.js:297-324 worst case —
+  one item per character, nothing can merge).
+
+Workload generation is untimed by design; these files keep the bench
+run inside its budget.
+"""
+
+import io
+import os
+import struct
+import sys
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.argv = [""]
+
+from bench import gen_prepend_fragmented, gen_trace  # noqa: E402
+
+FIX = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures",
+)
+
+N_STORM = int(os.environ.get("N_STORM", "256"))
+OPS = int(os.environ.get("YTPU_BENCH_OPS", "1500"))
+CHARS = int(os.environ.get("YTPU_BENCH_FRAG_CHARS", "100000"))
+
+storm_path = os.path.join(FIX, f"storm_traces_{OPS}.bin.z")
+if not os.path.exists(storm_path):
+    buf = io.BytesIO()
+    buf.write(struct.pack("<II", N_STORM, OPS))
+    for i in range(N_STORM):
+        u, _ = gen_trace(OPS, seed=5000 + i, n_clients=4, sync_p=0.08)
+        buf.write(struct.pack("<I", len(u)) + u)
+        if (i + 1) % 32 == 0:
+            print(f"storm {i + 1}/{N_STORM}", flush=True)
+    with open(storm_path + ".tmp", "wb") as f:
+        f.write(zlib.compress(buf.getvalue(), 9))
+    os.replace(storm_path + ".tmp", storm_path)
+    print("wrote", storm_path)
+
+frag_path = os.path.join(FIX, f"prepend_frag_{CHARS}.bin.z")
+if not os.path.exists(frag_path):
+    u, _ = gen_prepend_fragmented(CHARS)
+    with open(frag_path + ".tmp", "wb") as f:
+        f.write(zlib.compress(u, 9))
+    os.replace(frag_path + ".tmp", frag_path)
+    print("wrote", frag_path, f"({len(u)} bytes raw)")

@@ -846,3 +846,101 @@ class TestChunkedFlushStress:
         for i in range(n):
             assert_engine_matches(eng, docs[i], i)
         assert eng.last_flush_metrics["n_pending_docs"] == 0
+
+
+class TestLaneBucketing:
+    """_bucket_lanes (VERDICT r4 item 9): mantissa-quantized lane widths
+    cap padding waste at 12.5% while keeping compiled shapes bounded."""
+
+    def test_properties(self):
+        from yjs_tpu.ops.engine import _bucket_lanes
+
+        assert _bucket_lanes(0) == 64 and _bucket_lanes(64) == 64
+        prev = 0
+        seen_per_octave: dict[int, set] = {}
+        for n in range(1, 200000, 7):
+            b = _bucket_lanes(n)
+            assert b >= n and b >= 64
+            assert b >= prev or n <= 64  # monotone
+            prev = b
+            if n > 64:
+                assert b / n <= 1.125 + 1e-9, (n, b)
+            assert _bucket_lanes(b) == b  # idempotent (stable shapes)
+            seen_per_octave.setdefault(b.bit_length(), set()).add(b)
+        # bounded distinct shapes: at most 2**bits per power-of-two octave
+        for octave, vals in seen_per_octave.items():
+            assert len(vals) <= 8 + 1, (octave, sorted(vals))
+
+    def test_flush_occupancy_and_shape_stability(self, rng):
+        """Multi-doc flush occupancy >= 0.92, and flushes whose lane
+        demand differs by <12.5% reuse the SAME padded widths (= the
+        dispatch hits the jit cache by construction)."""
+        import yjs_tpu as Y
+        from yjs_tpu.ops import BatchEngine
+
+        def mk_updates(n_docs, ops, seed0):
+            # two-client conflict texture: realistic fragmentation so the
+            # lane demand is real work, not floor padding
+            outs = []
+            for k in range(n_docs):
+                gen = random.Random(seed0 + k)
+                a = Y.Doc(gc=False)
+                a.client_id = 1000 + 2 * k
+                b = Y.Doc(gc=False)
+                b.client_id = 1001 + 2 * k
+
+                def sync(a=a, b=b):
+                    ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+                    ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+                    Y.apply_update(b, ua)
+                    Y.apply_update(a, ub)
+
+                for i in range(ops + gen.randint(0, ops // 20)):
+                    d = a if gen.random() < 0.5 else b
+                    t = d.get_text("text")
+                    ln = len(t.to_string())
+                    if gen.random() < 0.75 or ln == 0:
+                        t.insert(gen.randint(0, ln), gen.choice(["ab", "c "]))
+                    else:
+                        pos = gen.randrange(ln)
+                        t.delete(pos, min(gen.randint(1, 3), ln - pos))
+                    if gen.random() < 0.2:
+                        sync()
+                sync()
+                outs.append(Y.encode_state_as_update(a))
+            return outs
+
+        eng = BatchEngine(32)
+        for i, u in enumerate(mk_updates(32, 120, 5000)):
+            eng.queue_update(i, u)
+        eng.flush()
+        occ = eng.last_flush_metrics["schedule_occupancy"]
+        # >=0.90 at this 32-doc scale (the fixed 64/64/8/64 minimum-width
+        # floors are ~5% of demand here); the 1024-doc distinct fixture
+        # measures 0.96+ (BASELINE.md r5), vs 0.844 with pure powers of two
+        assert occ >= 0.90, occ
+        # second engine, ~5% different demand -> identical lane widths
+        import yjs_tpu.ops.engine as engine_mod
+
+        widths = []
+        orig = engine_mod.pack_apply_lanes
+
+        def spy(work, doc_ids, b_loc, n_shards, w, *a, **k):
+            widths.append(w)
+            return orig(work, doc_ids, b_loc, n_shards, w, *a, **k)
+
+        engine_mod.pack_apply_lanes = spy
+        try:
+            for run, seed0 in enumerate(range(6000, 6600, 100)):
+                e1 = BatchEngine(32)
+                ops = 120 + (run % 3) * 4  # ±~5% demand wobble per run
+                for i, u in enumerate(mk_updates(32, ops, seed0)):
+                    e1.queue_update(i, u)
+                e1.flush()
+        finally:
+            engine_mod.pack_apply_lanes = orig
+        assert len(widths) >= 6
+        # bucketing must COLLAPSE the wobble onto few padded shapes (each
+        # repeat = a jit-cache hit); exact widths would give one distinct
+        # tuple per run
+        assert len(set(widths)) <= len(widths) // 2, widths

@@ -94,6 +94,28 @@ def _bucket(n: int, minimum: int = 64) -> int:
     return b
 
 
+# scatter-lane width quantization: 2**bits mantissa steps per power-of-two
+# octave.  bits=3 (default) caps padding waste at 12.5% of the request
+# (vs 50% for pure powers of two) while keeping the distinct compiled
+# shapes bounded at 8 per octave — the measured-distribution bucketing of
+# VERDICT r4 item 9.  bits=0 restores pure powers of two.
+_PAD_BITS = max(0, min(6, int(os.environ.get("YTPU_PAD_BITS", "3"))))
+
+
+def _bucket_lanes(n: int, minimum: int = 64) -> int:
+    """Round a per-flush LANE width up to the next mantissa-quantized
+    bucket.  Used only for transfer-lane widths (the occupancy metric);
+    device STATE capacities keep plain powers of two, where fewer, larger
+    growth steps amortize the on-device copy better."""
+    if n <= minimum:
+        return minimum
+    bits = _PAD_BITS
+    if bits == 0:
+        return _bucket(n, minimum)
+    e = max(0, (n - 1).bit_length() - 1 - bits)
+    return ((n + (1 << e) - 1) >> e) << e
+
+
 # target size of one level-axis schedule tile (entries per doc-batch block);
 # big enough that kernel launch overhead amortizes, small enough that the
 # padded [B, block, W, 8] tile stays modest at any log length
@@ -528,7 +550,7 @@ class BatchEngine:
         # packed [8, K] transfer: per-array transfers each pay full link
         # latency on tunneled backends.
         total = len(d)
-        padded = _bucket(total, 64)
+        padded = _bucket_lanes(total, 64)
         packed = np.empty((2 + len(self._STATIC_COLS), padded), np.int32)
         packed[0, :total] = d
         packed[0, total:] = 0
@@ -929,7 +951,7 @@ class BatchEngine:
                     shard[mask], weights=values[mask].astype(np.float64),
                     minlength=n_shards,
                 )
-                return _bucket(int(sums.max(initial=0)), minimum)
+                return _bucket_lanes(int(sums.max(initial=0)), minimum)
 
             all_mask = np.ones(len(chunk_ok), bool)
             k_dn = shard_max(link, dense, 64)
@@ -1064,7 +1086,7 @@ class BatchEngine:
                     dl_r[s].append(np.asarray(p.delete_rows, np.int32))
 
             def widths(parts_by_shard, minimum):
-                return _bucket(
+                return _bucket_lanes(
                     max(
                         (sum(len(a) for a in parts) for parts in parts_by_shard),
                         default=0,
@@ -1296,8 +1318,12 @@ class BatchEngine:
         name = name or self.root_name
         fb = self.fallback.get(doc)
         if fb is not None:
+            # type-agnostic root access: the mirror branch below walks
+            # segment rows without caring about the root's kind, so the
+            # demoted branch must too (get_text on an already-typed
+            # array/xml root raises)
             return create_relative_position_from_type_index(
-                fb.get_text(name), index
+                fb.get(name), index
             )
         m = self.mirrors[doc]
         seg = m.segments.get((name, None, NULL))

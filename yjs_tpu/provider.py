@@ -55,6 +55,8 @@ class TpuProvider:
         # per-room server-side undo stacks (opt-in; see enable_undo)
         self._undo: dict[str, object] = {}
         self._undo_settings: dict[str, tuple] = {}
+        # memoized attribution views (see user_data)
+        self._user_data: dict[tuple[str, str], object] = {}
 
     # -- doc management -----------------------------------------------------
 
@@ -334,8 +336,16 @@ class TpuProvider:
         type.  The server answers ``user_by_client_id`` /
         ``user_by_deleted_id`` by reading the map straight out of the
         mirror (ids arrays, encoded-DeleteSet blobs) — no CPU doc, no
-        observers, no replica."""
-        return RoomUserData(self, guid, store_name)
+        observers, no replica.  The handle is memoized per (guid,
+        store_name) so the per-query call pattern
+        ``prov.user_data(g).user_by_client_id(c)`` actually hits the
+        content_gen parse cache."""
+        key = (guid, store_name)
+        rud = self._user_data.get(key)
+        if rud is None:
+            rud = RoomUserData(self, guid, store_name)
+            self._user_data[key] = rud
+        return rud
 
     # -- cursors (relative positions) ---------------------------------------
 
