@@ -342,6 +342,7 @@ def bench_distinct(
         t_u, n_el = unique[u]
         cpu_elems += n_el
         cpu_time += t_u
+    n_unique = len(unique)
     del unique
 
     # compile warmup: an identically-shaped engine run (fresh engine, same
@@ -421,6 +422,11 @@ def bench_distinct(
             "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
             "plan_threads": m.get("plan_threads", 1),
             "n_demoted": m.get("n_demoted", 0),
+            # honesty marker: docs repeat trace BYTES cyclically when the
+            # fixture (or synthesis fallback) holds fewer unique traces
+            # than docs — per-doc engine work is identical either way,
+            # but the reader must see the repetition (no silent caps)
+            "unique_traces": n_unique,
         },
         eng,
     )
@@ -509,9 +515,11 @@ def load_prepend_fixture(n_chars: int) -> bytes:
 
 
 # isolated-measurement band for sync_step2_batched at 1024 docs on this
-# host (BASELINE.md r5): single-window readings below it indicate harness
-# contention (cleanup RPCs / tunnel weather), not a code regression
-_SYNC_BAND = (7300.0, 8700.0)
+# host (BASELINE.md r5: 5 isolated reps measured 7.3-8.0k/s; r3 recorded
+# 7.6-8.7k in its sessions).  Single-window readings below the floor
+# indicate harness contention (cleanup RPCs / tunnel weather), not a
+# code regression.
+_SYNC_BAND = (7300.0, 8000.0)
 
 
 def bench_sync(eng, n_docs: int) -> dict:
@@ -612,11 +620,17 @@ def main():
     node_proxy_distinct = distinct["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
     node_proxy_b4 = b4["cpu_py_elems_per_sec"] * NODE_PROXY_FACTOR
     headline = distinct["e2e_elems_per_sec"]
+    uniq = distinct["unique_traces"]
+    distinct_label = (
+        f"{distinct['n_docs']} DISTINCT docs"
+        if uniq >= distinct["n_docs"]
+        else f"{distinct['n_docs']} docs cycling {uniq} unique traces"
+    )
     result = {
         "metric": "distinct_docs_e2e_elements_per_sec",
         "value": headline,
         "unit": (
-            f"elem/s end-to-end ({distinct['n_docs']} DISTINCT docs x "
+            f"elem/s end-to-end ({distinct_label} x "
             f"{n_ops}-op traces through the full engine path: decode+plan+"
             f"pack+transfer+apply; vs Node PROXY = python_core x"
             f"{NODE_PROXY_FACTOR:g}, see BASELINE.md.  Broadcast fan-out "

@@ -206,6 +206,19 @@ def load():
         lib._has_plan_threads = True
     except AttributeError:
         lib._has_plan_threads = False
+    try:
+        # r5: one ctypes crossing registers every staged buffer of a flush
+        lib.ymx_add_bufs_many.restype = None
+        lib.ymx_add_bufs_many.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib._has_add_bufs_many = True
+    except AttributeError:
+        lib._has_add_bufs_many = False
     _lib = lib
     return _lib
 

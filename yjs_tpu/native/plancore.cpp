@@ -166,8 +166,12 @@ struct Mirror {
   std::unordered_map<std::string, int64_t> interned;
   std::vector<int64_t> intern_ofs, intern_len;
 
-  // delete-set bookkeeping: per-slot ranges + slot first-note order
-  std::unordered_map<int64_t, std::vector<std::array<int64_t, 2>>> ds;
+  // delete-set bookkeeping: per-slot ranges (slot-indexed — slots are
+  // dense small ints, so indexing beats hashing per deleted row) + slot
+  // first-note order; a slot is "present" iff its range list is
+  // non-empty (note_deleted is the only writer and never leaves one
+  // empty)
+  std::vector<std::vector<std::array<int64_t, 2>>> ds;
   std::vector<int64_t> ds_slot_order;
 
   // pending causally-early refs per client + pending delete ranges
@@ -419,13 +423,10 @@ struct Mirror {
   // ---- row / fragment bookkeeping (DocMirror._add_row etc.) -------------
 
   void note_deleted(int64_t slot_, int64_t clock, int64_t len) {
-    auto it = ds.find(slot_);
-    if (it == ds.end()) {
-      ds_slot_order.push_back(slot_);
-      ds[slot_].push_back({{clock, len}});
-    } else {
-      it->second.push_back({{clock, len}});
-    }
+    if ((size_t)slot_ >= ds.size()) ds.resize((size_t)slot_ + 1);
+    auto& v = ds[(size_t)slot_];
+    if (v.empty()) ds_slot_order.push_back(slot_);
+    v.push_back({{clock, len}});
   }
 
   void reserve_rows(size_t extra) {
@@ -1762,7 +1763,8 @@ struct Mirror {
       segs_of_parent = std::move(parents);
     }
     // compact DS ranges (sorted union per slot)
-    for (auto& [slot_, ranges] : ds) {
+    for (auto& ranges : ds) {
+      if (ranges.empty()) continue;
       std::sort(ranges.begin(), ranges.end());
       std::vector<std::array<int64_t, 2>> out;
       for (auto& [clock, ln] : ranges) {
@@ -2426,6 +2428,16 @@ extern "C" {
 void* ymx_new() { return new Mirror(); }
 void ymx_free(void* h) { delete static_cast<Mirror*>(h); }
 
+// batched buffer registration across docs: one ctypes crossing for the
+// whole flush's staged updates (hs[i] may repeat for docs staging more
+// than one update; ids come back in input order)
+void ymx_add_bufs_many(void** hs, const uint8_t* const* ptrs,
+                       const uint64_t* lens, int64_t n, int64_t* out_ids) {
+  for (int64_t i = 0; i < n; i++)
+    out_ids[i] =
+        static_cast<Mirror*>(hs[i])->add_buf(ptrs[i], lens[i]);
+}
+
 int64_t ymx_add_buf(void* h, const uint8_t* p, uint64_t n) {
   return static_cast<Mirror*>(h)->add_buf(p, n);
 }
@@ -2822,13 +2834,15 @@ void ymx_chain(void* h, int64_t seg, int64_t* out) {
 int64_t ymx_ds_count(void* h) {
   Mirror* m = static_cast<Mirror*>(h);
   int64_t n = 0;
-  for (auto& [s, v] : m->ds) n += (int64_t)v.size();
+  for (auto& v : m->ds) n += (int64_t)v.size();
   return n;
 }
 void ymx_ds(void* h, int64_t* slot, int64_t* clock, int64_t* len) {
   Mirror* m = static_cast<Mirror*>(h);
   for (int64_t s : m->ds_slot_order)
-    for (auto& [c, l] : m->ds[s]) { *slot++ = s; *clock++ = c; *len++ = l; }
+    for (auto& [c, l] : m->ds[(size_t)s]) {
+      *slot++ = s; *clock++ = c; *len++ = l;
+    }
 }
 
 // host list state (the device right_link/starts mirror)
@@ -2918,7 +2932,7 @@ int64_t ymx_encode_bound(void* h) {
   for (auto& c : m->r_c)
     content += (c.end >= 0 && c.ofs >= 0) ? (c.end - c.ofs) : 16;
   int64_t n_ds = 0;
-  for (auto& [s, v] : m->ds) n_ds += (int64_t)v.size();
+  for (auto& v : m->ds) n_ds += (int64_t)v.size();
   return 256 + m->n_rows() * 80 + content + (int64_t)m->strings.size() * 2 +
          24 * n_ds;
 }

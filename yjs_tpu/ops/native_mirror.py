@@ -727,22 +727,63 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
     lib = work[0][1]._lib
     handles = (ctypes.c_void_p * n)()
     buf_ofs = np.zeros(n + 1, np.int64)
-    ids_parts, v2_parts, staged_info = [], [], []
-    for k, (_i, m) in enumerate(work):
-        staged, ids, v2s = m._stage_bufs()
-        nb = len(staged)
-        staged_info.append((staged, ids))
-        buf_ofs[k + 1] = buf_ofs[k] + nb
-        if nb:
-            ids_parts.append(ids[:nb])
-            v2_parts.append(v2s[:nb])
-        handles[k] = m._h
-    ids_flat = (
-        np.concatenate(ids_parts) if ids_parts else np.zeros(1, np.int64)
-    )
-    v2_flat = (
-        np.concatenate(v2_parts) if v2_parts else np.zeros(1, np.int64)
-    )
+    if getattr(lib, "_has_add_bufs_many", False):
+        # batched staging: ONE native call registers every staged buffer.
+        # The c_char_p array extracts each bytes object's pointer in C
+        # (no per-buffer numpy view); the bytes stay pinned via _py_bufs.
+        all_bytes: list[bytes] = []
+        v2_list: list[int] = []
+        buf_hs = []
+        for k, (_i, m) in enumerate(work):
+            staged = m._incoming
+            buf_ofs[k + 1] = buf_ofs[k] + len(staged)
+            for u, v2 in staged:
+                all_bytes.append(u)
+                v2_list.append(1 if v2 else 0)
+                buf_hs.append(m._h)
+            handles[k] = m._h
+        nb_tot = len(all_bytes)
+        ids_flat = np.zeros(max(1, nb_tot), np.int64)
+        v2_flat = np.asarray(v2_list or [0], np.int64)
+        if nb_tot:
+            ptrs = (ctypes.c_char_p * nb_tot)(*all_bytes)
+            lens = np.fromiter(
+                (len(u) for u in all_bytes), np.uint64, nb_tot
+            )
+            bhs = (ctypes.c_void_p * nb_tot)(*buf_hs)
+            lib.ymx_add_bufs_many(
+                bhs, ptrs,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                nb_tot,
+                _p64(ids_flat),
+            )
+        staged_info = []
+        o = 0
+        for k, (_i, m) in enumerate(work):
+            staged = m._incoming
+            nb = len(staged)
+            ids = ids_flat[o : o + nb]
+            for j, (u, _v2) in enumerate(staged):
+                m._py_bufs[int(ids[j])] = (u, None)
+            staged_info.append((staged, np.asarray(ids, np.int64)))
+            o += nb
+    else:  # stale binary-only .so: per-doc staging
+        ids_parts, v2_parts, staged_info = [], [], []
+        for k, (_i, m) in enumerate(work):
+            staged, ids, v2s = m._stage_bufs()
+            nb = len(staged)
+            staged_info.append((staged, ids))
+            buf_ofs[k + 1] = buf_ofs[k] + nb
+            if nb:
+                ids_parts.append(ids[:nb])
+                v2_parts.append(v2s[:nb])
+            handles[k] = m._h
+        ids_flat = (
+            np.concatenate(ids_parts) if ids_parts else np.zeros(1, np.int64)
+        )
+        v2_flat = (
+            np.concatenate(v2_parts) if v2_parts else np.zeros(1, np.int64)
+        )
     counts = np.zeros((n, 16), np.int64)
     rcs = np.zeros(n, np.int64)
     lib.ymx_prepare_many(
