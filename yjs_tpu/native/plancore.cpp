@@ -310,6 +310,38 @@ struct Mirror {
     return (int64_t)bufs.size() - 1;
   }
 
+  // LSD radix sort for clock lists (non-negative, usually < 2^16): the
+  // same ascending result std::sort produces, with branch-free counting
+  // passes.  Scratch persists across prepares to avoid re-allocation.
+  std::vector<int64_t> radix_tmp;
+
+  void radix_sort_clocks(std::vector<int64_t>& v) {
+    size_t n = v.size();
+    if (n < 96) {  // small lists: introsort's constant wins
+      std::sort(v.begin(), v.end());
+      return;
+    }
+    int64_t mx = 0;
+    for (int64_t x : v) mx = x > mx ? x : mx;
+    if (radix_tmp.size() < n) radix_tmp.resize(n);
+    int64_t* src = v.data();
+    int64_t* dst = radix_tmp.data();
+    for (int shift = 0; (mx >> shift) > 0; shift += 8) {
+      size_t cnt[256] = {0};
+      for (size_t i = 0; i < n; i++) cnt[(src[i] >> shift) & 0xFF]++;
+      size_t sum = 0;
+      for (int b = 0; b < 256; b++) {
+        size_t c = cnt[b];
+        cnt[b] = sum;
+        sum += c;
+      }
+      for (size_t i = 0; i < n; i++)
+        dst[cnt[(src[i] >> shift) & 0xFF]++] = src[i];
+      std::swap(src, dst);
+    }
+    if (src != v.data()) std::memcpy(v.data(), src, n * sizeof(int64_t));
+  }
+
   // dedup'd dirty-row / dirty-head notes (sorted once at plan finalize)
   void mark_link(int64_t row) {
     if ((size_t)row >= dl_mark.size()) dl_mark.resize((size_t)row + 64, 0);
@@ -1259,9 +1291,12 @@ struct Mirror {
     lap("cuts-collect");
     for (auto& [client, ks] : cuts) {
       // mostly-ascending in practice (origins chain forward); skip the
-      // sort when the scan produced them in order
+      // sort when the scan produced them in order.  Clocks are small
+      // non-negative ints, so the unsorted case takes an LSD radix sort
+      // (branch-free counting passes beat introsort's compares on these
+      // ~1k-element lists).
       if (!std::is_sorted(ks.begin(), ks.end()))
-        std::sort(ks.begin(), ks.end());
+        radix_sort_clocks(ks);
       ks.erase(std::unique(ks.begin(), ks.end()), ks.end());
     }
 
