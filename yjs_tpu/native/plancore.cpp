@@ -322,11 +322,21 @@ struct Mirror {
       return;
     }
     int64_t mx = 0;
-    for (int64_t x : v) mx = x > mx ? x : mx;
+    bool neg = false;
+    for (int64_t x : v) {
+      mx = x > mx ? x : mx;
+      neg |= x < 0;
+    }
+    if (neg) {  // outside the clock domain (hostile bytes): total order
+      std::sort(v.begin(), v.end());
+      return;
+    }
     if (radix_tmp.size() < n) radix_tmp.resize(n);
     int64_t* src = v.data();
     int64_t* dst = radix_tmp.data();
-    for (int shift = 0; (mx >> shift) > 0; shift += 8) {
+    // shift < 64 bounds the pass loop even for mx >= 2^56 (a shift of 64
+    // would be UB; byte 7 of a non-negative int64 is covered at shift 56)
+    for (int shift = 0; shift < 64 && (mx >> shift) > 0; shift += 8) {
       size_t cnt[256] = {0};
       for (size_t i = 0; i < n; i++) cnt[(src[i] >> shift) & 0xFF]++;
       size_t sum = 0;
@@ -1463,12 +1473,20 @@ struct Mirror {
     }
 
     lap("rows");
-    // resolve delete ranges to row ids
+    // resolve delete ranges to row ids.  Ranges arrive grouped per
+    // client (update DS sections are per-client), so a 1-entry slot memo
+    // avoids a hash find per range; the memo must NOT create slots
+    // (unknown clients in a DS are skipped, not integrated).
+    int64_t del_cl_memo = INT64_MIN, del_slot_memo = kNull;
     for (size_t ai = 0; ai < applicable.size(); ai++) {
       auto [client, clock, ln] = applicable[ai];
-      auto sit = slot_of_client.find(client);
-      if (sit == slot_of_client.end()) continue;
-      int64_t slot_ = sit->second;
+      if (client != del_cl_memo) {
+        auto sit = slot_of_client.find(client);
+        del_cl_memo = client;
+        del_slot_memo = sit == slot_of_client.end() ? kNull : sit->second;
+      }
+      if (del_slot_memo == kNull) continue;
+      int64_t slot_ = del_slot_memo;
       auto& fc = frag_clock[slot_];
       auto& fr = frag_row[slot_];
       auto it = std::upper_bound(fc.begin(), fc.end(), clock);
