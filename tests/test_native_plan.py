@@ -494,3 +494,61 @@ def test_broadcast_kernels_agree(rng):
         else:
             xa, ya = xa[:, : mirror.n_segs], ya[:, : mirror.n_segs]
         assert (xa == ya).all(), name
+
+
+def test_pool_width_engine_state_identical(monkeypatch):
+    """Plans must be bit-identical at any worker-pool width: same updates
+    flushed under YTPU_PLAN_THREADS=1 and =4 produce identical engine
+    text, state vectors, and link/deleted exports (oversubscription on a
+    1-core host exercises the pool code path either way)."""
+    import random
+
+    import numpy as np
+
+    import yjs_tpu as Y
+    from yjs_tpu.ops import BatchEngine
+
+    def mk(seed):
+        gen = random.Random(seed)
+        a = Y.Doc(gc=False)
+        a.client_id = 900 + seed
+        b = Y.Doc(gc=False)
+        b.client_id = 950 + seed
+        for _ in range(120):
+            d = a if gen.random() < 0.5 else b
+            t = d.get_text("text")
+            ln = len(t.to_string())
+            if gen.random() < 0.7 or ln == 0:
+                t.insert(gen.randint(0, ln), gen.choice(["ab", "c ", "🙂"]))
+            else:
+                pos = gen.randrange(ln)
+                t.delete(pos, min(gen.randint(1, 3), ln - pos))
+            if gen.random() < 0.2:
+                ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+                ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+                Y.apply_update(b, ua)
+                Y.apply_update(a, ub)
+        u = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+        Y.apply_update(b, u)
+        return Y.encode_state_as_update(a)
+
+    updates = [mk(s) for s in range(12)]
+
+    def run(width):
+        monkeypatch.setenv("YTPU_PLAN_THREADS", width)
+        eng = BatchEngine(len(updates))
+        for i, u in enumerate(updates):
+            eng.queue_update(i, u)
+        eng.flush()
+        out = []
+        for i in range(len(updates)):
+            out.append((eng.text(i), tuple(sorted(eng.state_vector(i).items()))))
+        links = np.asarray(eng._right)
+        dels = np.asarray(eng._deleted)
+        return out, links, dels
+
+    out1, l1, d1 = run("1")
+    out4, l4, d4 = run("4")
+    assert out1 == out4
+    assert (l1 == l4).all()
+    assert (d1 == d4).all()
