@@ -874,7 +874,19 @@ class TestLaneBucketing:
     def test_flush_occupancy_and_shape_stability(self, rng):
         """Multi-doc flush occupancy >= 0.92, and flushes whose lane
         demand differs by <12.5% reuse the SAME padded widths (= the
-        dispatch hits the jit cache by construction)."""
+        dispatch hits the jit cache by construction).
+
+        Specific to the NATIVE bulk-apply lane packing: the levels/seq
+        cross-check kernels report schedule (not lane) occupancy, and
+        the Python-planner fallback takes the non-batched pack path."""
+        import os as _os
+
+        import pytest as _pytest
+
+        if _os.environ.get("YTPU_KERNEL", "apply") != "apply" or _os.environ.get(
+            "YTPU_NO_NATIVE_PLAN"
+        ):
+            _pytest.skip("bulk-apply native lane packing only")
         import yjs_tpu as Y
         from yjs_tpu.ops import BatchEngine
 
