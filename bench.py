@@ -567,6 +567,41 @@ def sweep_distinct(n_ops: int, sizes=(1024, 2048, 4096, 8192)) -> list[dict]:
     return rows
 
 
+def write_obs_artifacts(eng) -> dict:
+    """Persist the headline engine's observability state: the full
+    metrics snapshot JSON + a Perfetto-loadable Chrome trace
+    (YTPU_BENCH_OBS_PREFIX names them, default BENCH_obs_*).  Returns the
+    inline per-phase summary for the bench result — plan_threads,
+    schedule occupancy, per-phase p50 seconds — and never fails the
+    bench on a write error (obs is diagnostics, not the measurement)."""
+    out: dict = {}
+    try:
+        prefix = os.environ.get("YTPU_BENCH_OBS_PREFIX", "BENCH_obs")
+        snap = eng.metrics_snapshot()
+        m = eng.last_flush_metrics or {}
+        phase = snap.get("histograms", {}).get(
+            "ytpu_engine_phase_seconds", {}
+        )
+        out = {
+            "plan_threads": m.get("plan_threads", 1),
+            "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
+            "phase_seconds_p50": {
+                k.split("=", 1)[1]: round(v.get("p50", 0.0), 6)
+                for k, v in phase.items()
+            },
+            "flushes_recorded": snap.get("n_flushes_recorded", 0),
+        }
+        metrics_path = f"{prefix}_metrics.json"
+        with open(metrics_path, "w") as f:
+            json.dump(snap, f)
+        out["metrics_path"] = metrics_path
+        out["trace_path"] = eng.save_trace(f"{prefix}_trace.json")
+        out["trace_events"] = len(eng.obs.tracer)
+    except Exception as e:  # pragma: no cover - diagnostics only
+        out["error"] = repr(e)
+    return out
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -593,6 +628,9 @@ def main():
     # deletes before timing sync (cleanup RPCs share the host core)
     time.sleep(3)
     sync = bench_sync(eng, n_docs_distinct)
+    # capture the headline engine's obs state (snapshot + Chrome trace)
+    # before it dies — the artifacts prove what the timed runs did
+    obs_summary = write_obs_artifacts(eng)
     del eng
     import gc
 
@@ -660,6 +698,7 @@ def main():
                 / max(1.0, distinct["cpu_py_elems_per_sec"]),
                 2,
             ),
+            "obs": obs_summary,
         },
     }
     if sweep is not None:

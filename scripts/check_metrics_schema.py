@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Diff the LIVE registered metric names against README's documented list.
+
+Instantiates a provider (which registers every engine + provider metric
+family at construction) plus the process-global registry, extracts the
+``ytpu_*`` names from the README Observability table, and fails when
+either side has a name the other lacks — so the docs and the exposition
+surface cannot drift apart.  Wired as a tier-1 check via
+tests/test_obs.py-adjacent usage and runnable standalone:
+
+    python scripts/check_metrics_schema.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def documented_names(readme_text: str) -> set[str]:
+    """Backticked ytpu_* names from the Observability metric table rows
+    (lines shaped ``| `ytpu_...` | kind | ...``)."""
+    names = set()
+    for line in readme_text.splitlines():
+        m = re.match(r"\|\s*`(ytpu_[a-z0-9_]+)`\s*\|", line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def registered_names() -> set[str]:
+    from yjs_tpu.obs import global_registry
+    from yjs_tpu.provider import TpuProvider
+
+    prov = TpuProvider(1)
+    return set(prov.engine.obs.registry.names()) | set(
+        global_registry().names()
+    )
+
+
+def main() -> int:
+    doc = documented_names((ROOT / "README.md").read_text())
+    live = registered_names()
+    if not live:
+        print("obs disabled (YTPU_OBS_DISABLED) — nothing to check")
+        return 0
+    undocumented = sorted(live - doc)
+    stale = sorted(doc - live)
+    if undocumented:
+        print("registered but NOT in README's Observability table:")
+        for n in undocumented:
+            print(f"  {n}")
+    if stale:
+        print("documented in README but NOT registered:")
+        for n in stale:
+            print(f"  {n}")
+    if undocumented or stale:
+        return 1
+    print(f"ok: {len(live)} metric families, docs and registry agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

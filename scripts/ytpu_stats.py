@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""ytpu_stats: human-readable view of yjs_tpu observability snapshots.
+
+Two modes:
+
+    python scripts/ytpu_stats.py <snapshot.json>
+        Pretty-print a metrics snapshot written by
+        ``engine.metrics_snapshot()`` / ``provider.metrics_snapshot()``
+        (e.g. bench.py's BENCH_obs_metrics.json artifact).
+
+    python scripts/ytpu_stats.py --demo [--prom|--json]
+        Exercise a tiny in-process provider (a few rooms, a sync
+        handshake, one undo) and dump its metrics: the rendered view by
+        default, raw Prometheus text with --prom, the JSON snapshot with
+        --json.  The zero-to-metrics smoke test for the obs subsystem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_snapshot(snap: dict) -> str:
+    lines: list[str] = []
+
+    def section(title, rows):
+        if not rows:
+            return
+        lines.append(title)
+        w = max(len(r[0]) for r in rows)
+        for name, val in rows:
+            lines.append(f"  {name:<{w}}  {val}")
+        lines.append("")
+
+    def flatten(kind_map):
+        rows = []
+        for name in sorted(kind_map):
+            for labels_key, val in sorted(kind_map[name].items()):
+                label = f"{name}{{{labels_key}}}" if labels_key else name
+                rows.append((label, val))
+        return rows
+
+    section(
+        "counters",
+        [(n, _fmt(v)) for n, v in flatten(snap.get("counters", {}))],
+    )
+    section(
+        "gauges",
+        [(n, _fmt(v)) for n, v in flatten(snap.get("gauges", {}))],
+    )
+    section(
+        "histograms (count / p50 / p95 / p99 / max)",
+        [
+            (
+                n,
+                f"{s['count']} / {_fmt(s['p50'])} / {_fmt(s['p95'])} / "
+                f"{_fmt(s['p99'])} / {_fmt(s['max'])}",
+            )
+            for n, s in flatten(snap.get("histograms", {}))
+        ],
+    )
+    flush = snap.get("flush")
+    if flush:
+        section(
+            f"last flush (1 of {snap.get('n_flushes_recorded', '?')} "
+            f"recorded, {len(snap.get('flush_history', []))} in ring)",
+            [(k, _fmt(flush[k])) for k in sorted(flush)],
+        )
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def demo_snapshot():
+    """A tiny provider workload touching every instrumented seam."""
+    from yjs_tpu import Doc
+    from yjs_tpu.provider import TpuProvider
+    from yjs_tpu.updates import encode_state_as_update
+
+    prov = TpuProvider(4)
+    for k in range(3):
+        d = Doc(gc=False)
+        d.get_text("text").insert(0, f"room {k} says hello")
+        prov.receive_update(f"room{k}", encode_state_as_update(d))
+    prov.flush()
+    prov.handle_sync_message("room0", prov.sync_step1("room0"))
+    prov.enable_undo("room1")
+    d = Doc(gc=False)
+    d.get_text("text").insert(0, "undo me. ")
+    prov.receive_update("room1", encode_state_as_update(d), undoable=True)
+    prov.flush()
+    prov.undo("room1")
+    return prov
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ytpu_stats", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("snapshot", nargs="?", help="metrics snapshot JSON file")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny provider workload instead of reading a file")
+    ap.add_argument("--prom", action="store_true",
+                    help="with --demo: print Prometheus text instead")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="with --demo: print the raw JSON snapshot instead")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        prov = demo_snapshot()
+        if args.prom:
+            sys.stdout.write(prov.metrics_text())
+        elif args.as_json:
+            json.dump(prov.metrics_snapshot(), sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_snapshot(prov.metrics_snapshot()))
+        return 0
+    if not args.snapshot:
+        ap.error("either a snapshot file or --demo is required")
+    with open(args.snapshot) as f:
+        snap = json.load(f)
+    sys.stdout.write(render_snapshot(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,366 @@
+"""yjs_tpu.obs: metrics registry, flush-history ring, span tracing,
+exposition (ISSUE 1).
+
+Fast host-only tests: ring semantics, histogram bucket/percentile math,
+Chrome-trace JSON validity, flush-metrics schema parity across every
+flush mode, Prometheus text, and the provider's defensive metrics copy.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.obs import FLUSH_METRICS_SCHEMA, global_registry, new_flush_metrics
+from yjs_tpu.obs.history import FlushHistory
+from yjs_tpu.obs.registry import Histogram, MetricsRegistry
+from yjs_tpu.ops import BatchEngine
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.updates import encode_state_as_update
+
+
+def _update(text="hello"):
+    d = Y.Doc(gc=False)
+    d.get_text("text").insert(0, text)
+    return encode_state_as_update(d)
+
+
+# -- flush-history ring ------------------------------------------------------
+
+
+def test_ring_bounded_fifo_and_alias():
+    ring = FlushHistory(maxlen=4)
+    entries = [{"i": i} for i in range(6)]
+    for e in entries:
+        ring.append(e)
+    assert len(ring) == 4
+    # FIFO eviction: the two oldest entries are gone
+    assert [m["i"] for m in ring] == [2, 3, 4, 5]
+    assert ring[0] is entries[2]
+    # latest is the SAME object as the newest append (the
+    # last_flush_metrics alias contract), while snapshot() copies
+    assert ring.latest is entries[-1]
+    assert ring.snapshot() == [{"i": 2}, {"i": 3}, {"i": 4}, {"i": 5}]
+    assert ring.snapshot()[0] is not entries[2]
+    assert ring.total == 6
+
+
+def test_engine_ring_one_entry_per_flush(monkeypatch):
+    monkeypatch.setenv("YTPU_OBS_HISTORY", "3")
+    eng = BatchEngine(2)
+    for k in range(5):
+        eng.queue_update(0, _update(f"v{k}"))
+        eng.flush()
+    assert eng.obs.history.total == 5
+    assert len(eng.obs.history) == 3  # bounded by YTPU_OBS_HISTORY
+    # last_flush_metrics is the newest ring entry ITSELF, not a copy
+    assert eng.last_flush_metrics is eng.obs.history.latest
+    assert eng.last_flush_metrics["n_docs_flushed"] == 1
+    # empty flushes are real flushes: they get a ring entry too
+    eng.flush()
+    assert eng.obs.history.total == 6
+    assert eng.last_flush_metrics["n_docs_flushed"] == 0
+
+
+# -- histogram math ----------------------------------------------------------
+
+
+def test_histogram_exact_stats_and_percentiles():
+    h = Histogram("t")
+    for v in range(1, 1001):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 1000
+    assert s["sum"] == pytest.approx(500500.0)
+    assert s["min"] == 1.0
+    assert s["max"] == 1000.0
+    # 8 buckets/octave => quantiles land within ~4.5% of the true value
+    assert s["p50"] == pytest.approx(500.0, rel=0.05)
+    assert s["p95"] == pytest.approx(950.0, rel=0.05)
+    assert s["p99"] == pytest.approx(990.0, rel=0.05)
+
+
+def test_histogram_quantile_clamped_and_zero_bucket():
+    h = Histogram("t")
+    h.observe(42.0)
+    # single observation: every quantile IS that value (midpoint clamped
+    # into [min, max])
+    assert h.quantile(0.5) == 42.0
+    assert h.quantile(0.99) == 42.0
+    z = Histogram("z")
+    z.observe(0.0)
+    z.observe(0.0)
+    z.observe(8.0)
+    assert z.quantile(0.5) == 0.0  # underflow bucket reports min
+    assert z.summary()["max"] == 8.0
+    assert Histogram("e").summary() == {
+        "count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+        "p50": 0.0, "p95": 0.0, "p99": 0.0,
+    }
+
+
+def test_histogram_bucket_relative_error_across_decades():
+    # the geometric-midpoint readback stays within the 8-per-octave bound
+    # (2**(1/16) - 1 ~ 4.4%) from microseconds to kiloseconds
+    for v in (1e-6, 3.7e-4, 0.02, 1.5, 88.0, 4096.0):
+        h = Histogram("t")
+        for _ in range(100):
+            h.observe(v)
+        assert h.quantile(0.5) == pytest.approx(v, rel=0.045)
+
+
+def test_registry_kind_mismatch_and_reuse():
+    r = MetricsRegistry()
+    c = r.counter("x", "help")
+    assert r.counter("x") is c  # re-registration returns the family
+    with pytest.raises(ValueError):
+        r.gauge("x")
+    lab = r.counter("y", labelnames=("k",))
+    lab.labels(k="a").inc(2)
+    lab.labels(k="a").inc()
+    assert lab.labels(k="a").value == 3
+    assert lab.labels(k="b").value == 0
+
+
+# -- flush-metrics schema ----------------------------------------------------
+
+
+def test_new_flush_metrics_rejects_unknown_keys():
+    m = new_flush_metrics(n_demoted=2)
+    assert m["n_demoted"] == 2
+    assert set(m) == set(FLUSH_METRICS_SCHEMA)
+    with pytest.raises(KeyError):
+        new_flush_metrics(no_such_metric=1)
+
+
+def test_flush_metrics_schema_identical_across_modes():
+    """apply / levels / seq / pure-Python planner: one key set
+    (FLUSH_METRICS_SCHEMA), no mode-specific drift."""
+    keysets = {}
+    for mode in ("native", "apply", "levels", "seq", "python"):
+        if mode == "python":
+            os.environ["YTPU_NO_NATIVE_PLAN"] = "1"
+        elif mode != "native":
+            os.environ["YTPU_KERNEL"] = mode
+        try:
+            eng = BatchEngine(2)
+            eng.queue_update(0, _update())
+            eng.queue_update(1, _update("other"))
+            eng.flush()
+            keysets[mode] = set(eng.last_flush_metrics)
+        finally:
+            os.environ.pop("YTPU_KERNEL", None)
+            os.environ.pop("YTPU_NO_NATIVE_PLAN", None)
+    for mode, keys in keysets.items():
+        assert keys == set(FLUSH_METRICS_SCHEMA), mode
+
+
+# -- span tracing ------------------------------------------------------------
+
+
+def test_chrome_trace_json_valid_and_phased():
+    eng = BatchEngine(2)
+    n_flushes = 2
+    for k in range(n_flushes):
+        eng.queue_update(0, _update(f"flush{k}"))
+        eng.flush()
+    trace = eng.export_chrome_trace()
+    # loadable: a strict JSON round trip of the Perfetto container shape
+    loaded = json.loads(json.dumps(trace))
+    assert loaded["displayTimeUnit"] == "ms"
+    events = loaded["traceEvents"]
+    assert events
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)  # monotonic
+    for e in events:
+        assert e["ph"] in ("X", "i")
+        if e["ph"] == "X":  # complete events carry a duration
+            assert e["dur"] >= 0.0
+        assert {"name", "pid", "tid", "cat"} <= set(e)
+    names = [e["name"] for e in events]
+    # one flush span per flush, one span per host phase per flush
+    assert names.count("ytpu.flush") == n_flushes
+    for phase in ("compact", "emit"):
+        assert names.count(f"ytpu.{phase}") == n_flushes
+    # work flushed every time, so plan+pack+dispatch ran each flush (the
+    # chunked batched path emits one span per chunk on top of the
+    # prepare-scan span: >=)
+    for phase in ("plan", "pack", "dispatch"):
+        assert names.count(f"ytpu.{phase}") >= n_flushes
+
+
+def test_trace_instant_on_demotion():
+    eng = BatchEngine(1)
+    d = Y.Doc(gc=False)
+    d.get_text("text").insert(0, "x")
+    sub = Y.Doc(gc=False)
+    d.get_map("m").set("sub", sub)  # subdoc -> device demotion
+    eng.queue_update(0, encode_state_as_update(d))
+    eng.flush()
+    assert len(eng.fallback) == 1
+    events = eng.export_chrome_trace()["traceEvents"]
+    inst = [e for e in events if e["ph"] == "i" and e["name"] == "ytpu.demote"]
+    assert len(inst) == 1
+    assert inst[0]["s"] == "t"
+    assert inst[0]["args"]["doc"] == 0
+    # and the labeled demotion counter matches the ledger
+    fams = dict.fromkeys(eng.obs.registry.names())
+    assert "ytpu_engine_demotions_total" in fams
+    total = sum(
+        series.value
+        for _labels, series in eng.obs.registry.get(
+            "ytpu_engine_demotions_total"
+        ).samples()
+    )
+    assert total == len(eng.demotions) == 1
+
+
+def test_tracer_save(tmp_path):
+    eng = BatchEngine(1)
+    eng.queue_update(0, _update())
+    eng.flush()
+    p = eng.save_trace(str(tmp_path / "trace.json"))
+    with open(p) as f:
+        assert json.load(f)["traceEvents"]
+
+
+# -- exposition --------------------------------------------------------------
+
+
+def test_prometheus_text_dump():
+    prov = TpuProvider(2)
+    prov.receive_update("room", _update())
+    prov.flush()
+    prov.handle_sync_message("room", prov.sync_step1("room"))
+    text = prov.metrics_text()
+    assert "# TYPE ytpu_engine_flushes_total counter" in text
+    assert "# TYPE ytpu_engine_fallback_docs gauge" in text
+    # histograms render as summaries with the three quantile series
+    assert "# TYPE ytpu_engine_flush_seconds summary" in text
+    assert 'ytpu_engine_flush_seconds{quantile="0.5"}' in text
+    assert 'ytpu_engine_flush_seconds{quantile="0.95"}' in text
+    assert "ytpu_engine_flush_seconds_count" in text
+    assert 'ytpu_engine_phase_seconds{phase="plan",quantile="0.5"}' in text
+    assert "ytpu_provider_updates_received_total 1" in text
+    assert 'ytpu_provider_sync_messages_total{type="step1"} 1' in text
+    # every line is name{labels} value or a comment
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or len(line.rsplit(" ", 1)) == 2
+
+
+def test_json_snapshot_round_trips():
+    eng = BatchEngine(1)
+    eng.queue_update(0, _update())
+    eng.flush()
+    snap = json.loads(json.dumps(eng.metrics_snapshot()))
+    assert snap["schema"] == 1
+    assert snap["counters"]["ytpu_engine_flushes_total"][""] == 1
+    assert snap["flush"] == eng.last_flush_metrics
+    assert snap["flush_history"] == [eng.last_flush_metrics]
+    assert snap["n_flushes_recorded"] == 1
+    assert snap["histograms"]["ytpu_engine_flush_seconds"][""]["count"] == 1
+
+
+def test_provider_metrics_is_defensive_copy():
+    prov = TpuProvider(1)
+    prov.receive_update("r", _update())
+    prov.flush()
+    m = prov.metrics
+    assert set(m) == set(FLUSH_METRICS_SCHEMA)
+    m["n_docs_flushed"] = 999
+    m.clear()
+    assert prov.metrics["n_docs_flushed"] == 1
+    assert prov.engine.last_flush_metrics["n_docs_flushed"] == 1
+    # history snapshot is copies too
+    prov.metrics_history[0]["n_docs_flushed"] = 999
+    assert prov.metrics["n_docs_flushed"] == 1
+
+
+def test_sync_protocol_frame_counters():
+    fam = global_registry().get("ytpu_sync_messages_total")
+    if fam is None:  # process-global obs disabled by the environment
+        pytest.skip("YTPU_OBS_DISABLED in this process")
+
+    def val(direction, typ):
+        return fam.labels(dir=direction, type=typ).value
+
+    before = {
+        (d, t): val(d, t)
+        for d in ("read", "write")
+        for t in ("step1", "step2", "update")
+    }
+    from yjs_tpu.lib0.decoding import Decoder
+    from yjs_tpu.lib0.encoding import Encoder
+    from yjs_tpu.sync import protocol
+
+    a, b = Y.Doc(gc=False), Y.Doc(gc=False)
+    a.get_text("text").insert(0, "sync me")
+    enc = Encoder()
+    protocol.write_sync_step1(enc, b)
+    reply = Encoder()
+    protocol.read_sync_message(Decoder(enc.to_bytes()), reply, a)
+    protocol.read_sync_message(Decoder(reply.to_bytes()), Encoder(), b)
+    upd = Encoder()
+    protocol.write_update(upd, encode_state_as_update(a))
+    protocol.read_sync_message(Decoder(upd.to_bytes()), Encoder(), b)
+    assert b.get_text("text").to_string() == "sync me"
+    assert val("write", "step1") - before[("write", "step1")] == 1
+    assert val("read", "step1") - before[("read", "step1")] == 1
+    assert val("write", "step2") - before[("write", "step2")] == 1
+    assert val("read", "step2") - before[("read", "step2")] == 1
+    assert val("write", "update") - before[("write", "update")] == 1
+    assert val("read", "update") - before[("read", "update")] == 1
+
+
+def test_obs_disabled_keeps_flush_metrics(monkeypatch):
+    monkeypatch.setenv("YTPU_OBS_DISABLED", "1")
+    eng = BatchEngine(1)
+    assert not eng.obs.enabled
+    eng.queue_update(0, _update())
+    eng.flush()
+    # the compatibility surface survives: ring + last_flush_metrics work
+    assert set(eng.last_flush_metrics) == set(FLUSH_METRICS_SCHEMA)
+    assert eng.last_flush_metrics["n_docs_flushed"] == 1
+    assert len(eng.obs.history) == 1
+    # but nothing is registered, recorded, or traced for this engine
+    assert eng.obs.registry.names() == []
+    assert "ytpu_engine_" not in eng.metrics_text()
+    assert eng.export_chrome_trace()["traceEvents"] == []
+
+
+def test_metrics_schema_matches_readme():
+    """Every registered family is in README's Observability table and
+    vice versa (the scripts/check_metrics_schema.py contract, enforced
+    in tier-1 so docs can't drift)."""
+    import importlib.util
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema", root / "scripts" / "check_metrics_schema.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    live = mod.registered_names()
+    if not live:
+        pytest.skip("YTPU_OBS_DISABLED in this process")
+    doc = mod.documented_names((root / "README.md").read_text())
+    assert live - doc == set(), "registered but undocumented"
+    assert doc - live == set(), "documented but not registered"
+
+
+def test_native_prepare_histograms_on_batched_path():
+    eng = BatchEngine(2)
+    eng.queue_update(0, _update())
+    eng.queue_update(1, _update("two"))
+    eng.flush()
+    from yjs_tpu.ops.native_mirror import native_plan_available
+
+    fam = eng.obs.registry.get("ytpu_native_prepare_many_docs")
+    if not native_plan_available():
+        assert fam.count == 0  # python planner: batched path never runs
+        return
+    assert fam.count == 1
+    assert fam.summary()["max"] == 2.0  # both docs planned in one call

@@ -1,0 +1,239 @@
+"""yjs_tpu.obs: observability for the engine/provider stack.
+
+Four pieces (ISSUE 1 tentpole):
+
+- :mod:`.registry` — zero-dependency counters/gauges/log-bucketed
+  histograms, cheap enough to stay on in the flush hot path;
+- :mod:`.history` — the bounded flush-history ring superseding the
+  overwrite-only ``last_flush_metrics`` (which remains as a
+  compatibility view of the newest entry);
+- :mod:`.trace` — host-side phase spans exported as Chrome-trace JSON,
+  layered on the existing ``jax.profiler.TraceAnnotation`` wrappers;
+- :mod:`.expo` — Prometheus text dump + JSON snapshot.
+
+Env knobs: ``YTPU_OBS_DISABLED=1`` (no-op registry + tracer; the flush
+history stays on so ``last_flush_metrics`` keeps its contract),
+``YTPU_OBS_HISTORY`` (ring size, default 128), ``YTPU_TRACE_PATH``
+(write a merged Chrome trace at interpreter exit), ``YTPU_TRACE_EVENTS``
+(per-tracer event cap, default 200k).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .expo import prometheus_text, registry_snapshot  # noqa: F401
+from .history import FlushHistory  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NOOP_METRIC,
+)
+from .trace import Tracer  # noqa: F401
+
+SNAPSHOT_SCHEMA_VERSION = 1
+
+# -- the per-flush metrics schema -------------------------------------------
+# ONE constructor for every flush exit (apply / levels / seq / batched /
+# empty-early-return): the paths previously shared these keys by
+# convention only, and a drift was silent until a consumer KeyError'd.
+# tests/test_obs.py pins identical key sets across all modes.
+FLUSH_METRICS_SCHEMA: dict = {
+    "n_docs_flushed": 0,
+    "n_demoted": 0,
+    "n_fallback_docs": 0,
+    "n_rows_max": 0,
+    "n_sched_entries": 0,
+    "n_levels": 0,
+    "level_width": 0,
+    "schedule_occupancy": 0.0,
+    "n_pending_docs": 0,
+    "pending_depth": 0,
+    # worker-pool width the native planner fans per-doc plans out to
+    # (1 = serial / Python planner; YTPU_PLAN_THREADS overrides)
+    "plan_threads": 1,
+    "t_compact_s": 0.0,
+    "t_plan_s": 0.0,
+    "t_pack_s": 0.0,
+    "t_dispatch_s": 0.0,
+    "t_emit_s": 0.0,
+    "t_total_s": 0.0,
+}
+
+FLUSH_PHASES = ("compact", "plan", "pack", "dispatch", "emit")
+
+
+def new_flush_metrics(**overrides) -> dict:
+    """A fresh flush-metrics dict with every schema key present.
+
+    Unknown keys raise: a new metric must be added to the schema (and
+    the README table) first, so the exposed key set cannot drift."""
+    unknown = set(overrides) - set(FLUSH_METRICS_SCHEMA)
+    if unknown:
+        raise KeyError(
+            f"not in FLUSH_METRICS_SCHEMA: {sorted(unknown)}"
+        )
+    m = dict(FLUSH_METRICS_SCHEMA)
+    m.update(overrides)
+    return m
+
+
+def obs_enabled() -> bool:
+    return os.environ.get("YTPU_OBS_DISABLED") != "1"
+
+
+# -- process-global registry -------------------------------------------------
+# Serves module-level consumers with no engine handle (the y-protocols
+# sync framing).  Engine/provider exposition merges it in.
+
+_GLOBAL: MetricsRegistry | None = None
+
+
+def global_registry() -> MetricsRegistry:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = MetricsRegistry(enabled=obs_enabled())
+        # pre-register the protocol family so exposition (and the schema
+        # checker) sees it before the first frame is read/written
+        _GLOBAL.counter(
+            "ytpu_sync_messages_total",
+            "y-protocols sync frames processed by yjs_tpu.sync.protocol",
+            labelnames=("dir", "type"),
+        )
+    return _GLOBAL
+
+
+class EngineObs:
+    """Per-engine observability bundle: registry + flush ring + tracer.
+
+    Every instrument the flush hot path touches is pre-created here so
+    recording is attribute access + arithmetic — no name resolution, no
+    label resolution (phase children are pre-resolved)."""
+
+    def __init__(self, history_len: int | None = None):
+        self.enabled = obs_enabled()
+        self.registry = MetricsRegistry(enabled=self.enabled)
+        self.history = FlushHistory(maxlen=history_len)
+        self.tracer = Tracer(enabled=self.enabled)
+        r = self.registry
+        self._flushes = r.counter(
+            "ytpu_engine_flushes_total", "Engine flushes run"
+        )
+        self._docs_flushed = r.counter(
+            "ytpu_engine_docs_flushed_total",
+            "Docs integrated with visible work, summed over flushes",
+        )
+        self._updates_emitted = r.counter(
+            "ytpu_engine_updates_emitted_total",
+            "Incremental updates emitted via doc.on('update')",
+        )
+        self._egress_bytes = r.counter(
+            "ytpu_engine_update_egress_bytes_total",
+            "Bytes of emitted incremental updates",
+            unit="bytes",
+        )
+        self._demotions = r.counter(
+            "ytpu_engine_demotions_total",
+            "Device->CPU demotions by reason",
+            labelnames=("reason",),
+        )
+        self._fallback_docs = r.gauge(
+            "ytpu_engine_fallback_docs", "Docs currently on the CPU core"
+        )
+        self._pending_docs = r.gauge(
+            "ytpu_engine_pending_docs",
+            "Docs with parked (causally unready) traffic after last flush",
+        )
+        self._pending_depth = r.gauge(
+            "ytpu_engine_pending_depth",
+            "Total parked struct depth after last flush",
+        )
+        self._occupancy = r.gauge(
+            "ytpu_engine_schedule_occupancy",
+            "Real fraction of dispatched schedule/lane slots, last flush",
+            unit="ratio",
+        )
+        self._plan_threads = r.gauge(
+            "ytpu_engine_plan_threads", "Native planner worker-pool width"
+        )
+        self._row_capacity = r.gauge(
+            "ytpu_engine_row_capacity",
+            "Device row capacity (per doc) after last flush",
+            unit="rows",
+        )
+        self._flush_seconds = r.histogram(
+            "ytpu_engine_flush_seconds", "End-to-end flush wall time",
+            unit="s",
+        )
+        self._phase_seconds = r.histogram(
+            "ytpu_engine_phase_seconds",
+            "Per-phase flush wall time",
+            unit="s",
+            labelnames=("phase",),
+        )
+        self._phase_children = {
+            ph: self._phase_seconds.labels(phase=ph) for ph in FLUSH_PHASES
+        }
+        self._native_prepare_seconds = r.histogram(
+            "ytpu_native_prepare_many_seconds",
+            "One ymx_prepare_many batch (stage + plan), per call",
+            unit="s",
+        )
+        self._native_prepare_docs = r.histogram(
+            "ytpu_native_prepare_many_docs",
+            "Docs planned per ymx_prepare_many call",
+            unit="docs",
+        )
+
+    # -- hot-path recording hooks -------------------------------------
+
+    def record_flush(self, metrics: dict, row_capacity: int = 0) -> None:
+        """One flush finished: ring append + registry update."""
+        self.history.append(metrics)
+        if not self.enabled:
+            return
+        self._flushes.inc()
+        self._docs_flushed.inc(metrics["n_docs_flushed"])
+        self._fallback_docs.set(metrics["n_fallback_docs"])
+        self._pending_docs.set(metrics["n_pending_docs"])
+        self._pending_depth.set(metrics["pending_depth"])
+        self._occupancy.set(metrics["schedule_occupancy"])
+        self._plan_threads.set(metrics["plan_threads"])
+        self._row_capacity.set(row_capacity)
+        self._flush_seconds.observe(metrics["t_total_s"])
+        for ph, child in self._phase_children.items():
+            child.observe(metrics[f"t_{ph}_s"])
+
+    def demoted(self, doc: int, reason: str) -> None:
+        if not self.enabled:
+            return
+        self._demotions.labels(reason=reason).inc()
+        self.tracer.instant("ytpu.demote", doc=doc, reason=reason)
+
+    def update_emitted(self, n_bytes: int) -> None:
+        if not self.enabled:
+            return
+        self._updates_emitted.inc()
+        self._egress_bytes.inc(n_bytes)
+
+    def native_prepare(self, n_docs: int, dt_s: float) -> None:
+        if not self.enabled:
+            return
+        self._native_prepare_seconds.observe(dt_s)
+        self._native_prepare_docs.observe(n_docs)
+
+    # -- exposition ----------------------------------------------------
+
+    def metrics_text(self) -> str:
+        return prometheus_text(self.registry, global_registry())
+
+    def snapshot(self) -> dict:
+        snap = registry_snapshot(self.registry, global_registry())
+        snap["schema"] = SNAPSHOT_SCHEMA_VERSION
+        latest = self.history.latest
+        snap["flush"] = dict(latest) if latest is not None else None
+        snap["flush_history"] = self.history.snapshot()
+        snap["n_flushes_recorded"] = self.history.total
+        return snap

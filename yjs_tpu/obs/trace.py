@@ -1,0 +1,175 @@
+"""Host-side span tracing with Chrome-trace-format JSON export.
+
+Records phase spans (compact/plan/pack/dispatch/emit, planner-pool and
+demotion events) into an in-memory bounded buffer and exports the
+Chrome ``traceEvents`` JSON that Perfetto / chrome://tracing load
+directly.  This LAYERS ON the existing ``jax.profiler.TraceAnnotation``
+wrappers (which only surface inside an active device profiler trace) —
+the host spans are always available, profiler attached or not.
+
+``YTPU_TRACE_PATH=<file>`` makes every tracer created while the variable
+is set register for an atexit dump: all their events merge into one
+Chrome-trace JSON at interpreter exit.  ``Tracer.save(path)`` writes one
+tracer's trace explicitly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+
+DEFAULT_MAX_EVENTS = 200_000
+
+
+class _Span:
+    """Reusable context manager recording one complete ("X") event."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        tr = self._tracer
+        tr._events.append((
+            self._name,
+            "X",
+            (self._t0 - tr._t0) * 1e6,
+            (t1 - self._t0) * 1e6,
+            threading.get_ident(),
+            self._args,
+        ))
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Bounded in-memory span/event recorder (oldest events evicted)."""
+
+    def __init__(self, enabled: bool = True, max_events: int | None = None):
+        self.enabled = enabled
+        if max_events is None:
+            try:
+                max_events = int(
+                    os.environ.get("YTPU_TRACE_EVENTS", DEFAULT_MAX_EVENTS)
+                )
+            except ValueError:
+                max_events = DEFAULT_MAX_EVENTS
+        # (name, ph, ts_us, dur_us, tid, args) tuples
+        self._events: deque = deque(maxlen=max(16, max_events))
+        self._t0 = time.perf_counter()
+        self.pid = os.getpid()
+        if enabled and os.environ.get("YTPU_TRACE_PATH"):
+            _register_for_exit_dump(self)
+
+    def span(self, name: str, **args):
+        """Context manager recording a complete span around its body."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """Record a zero-duration marker (demotion, pool event, ...)."""
+        if not self.enabled:
+            return
+        self._events.append((
+            name,
+            "i",
+            (time.perf_counter() - self._t0) * 1e6,
+            0.0,
+            threading.get_ident(),
+            args or None,
+        ))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def trace_events(self) -> list[dict]:
+        """Chrome ``traceEvents`` list, sorted by timestamp."""
+        out = []
+        for name, ph, ts, dur, tid, args in sorted(
+            self._events, key=lambda e: e[2]
+        ):
+            ev = {
+                "name": name,
+                "ph": ph,
+                "ts": ts,
+                "pid": self.pid,
+                "tid": tid,
+                "cat": "ytpu",
+            }
+            if ph == "X":
+                ev["dur"] = dur
+            else:  # instant events: thread scope
+                ev["s"] = "t"
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The full Chrome-trace JSON object (loadable by Perfetto)."""
+        return {
+            "traceEvents": self.trace_events(),
+            "displayTimeUnit": "ms",
+        }
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+# -- YTPU_TRACE_PATH atexit dump --------------------------------------------
+
+_EXIT_TRACERS: list[Tracer] = []
+_EXIT_REGISTERED = False
+
+
+def _register_for_exit_dump(tracer: Tracer) -> None:
+    global _EXIT_REGISTERED
+    _EXIT_TRACERS.append(tracer)
+    if not _EXIT_REGISTERED:
+        atexit.register(_dump_exit_traces)
+        _EXIT_REGISTERED = True
+
+
+def _dump_exit_traces() -> None:
+    path = os.environ.get("YTPU_TRACE_PATH")
+    if not path or not _EXIT_TRACERS:
+        return
+    events: list[dict] = []
+    for tr in _EXIT_TRACERS:
+        events.extend(tr.trace_events())
+    events.sort(key=lambda e: e["ts"])
+    try:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    except OSError:
+        pass  # tracing must never take the process down at exit

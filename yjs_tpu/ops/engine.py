@@ -29,6 +29,7 @@ except Exception:  # pragma: no cover
 
 from ..core import Doc
 from ..lib0.u16 import from_u16
+from ..obs import EngineObs, new_flush_metrics
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
 from .native_mirror import (
@@ -164,6 +165,28 @@ def _phase(name: str):
     return jax.profiler.TraceAnnotation(f"ytpu.{name}")
 
 
+class _PhasePair:
+    """Two stacked phase contexts without ExitStack overhead — _phase_ctx
+    sits on the per-flush hot path (7 entries per flush)."""
+
+    __slots__ = ("_outer", "_inner")
+
+    def __init__(self, outer, inner):
+        self._outer = outer
+        self._inner = inner
+
+    def __enter__(self):
+        self._outer.__enter__()
+        self._inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            return self._inner.__exit__(*exc)
+        finally:
+            self._outer.__exit__(*exc)
+
+
 class BatchEngine:
     """Applies binary Yjs updates to a batch of docs on device.
 
@@ -247,8 +270,11 @@ class BatchEngine:
         # every demotion ever, with its reason — scope gaps are measurable,
         # not silent (each entry: {"doc", "reason"})
         self.demotions: list[dict] = []
-        # host-side per-phase timers + batch stats of the last flush
-        self.last_flush_metrics: dict | None = None
+        # observability bundle: metrics registry + flush-history ring +
+        # host span tracer (host-side per-phase timers + batch stats of
+        # every flush live in obs.history; last_flush_metrics is the
+        # compatibility view of the newest entry)
+        self.obs = EngineObs()
         self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
         # persistent device state (no left-link array: order is ranked from
         # right links with a host-known membership mask)
@@ -355,6 +381,7 @@ class BatchEngine:
         fb.on("afterTransaction", after_transaction)
 
     def _emit(self, doc: int, update: bytes) -> None:
+        self.obs.update_emitted(len(update))
         for cb in self._update_listeners:
             cb(doc, update)
 
@@ -372,6 +399,7 @@ class BatchEngine:
         own changes still deliver typed events — only historical replay
         stays silent."""
         self.demotions.append({"doc": doc, "reason": reason})
+        self.obs.demoted(doc, reason)
         fb = Doc(gc=False)
         observed = doc in self._event_listeners
         attached = False
@@ -612,9 +640,24 @@ class BatchEngine:
 
     # -- flush: run one device integration step ----------------------------
 
+    def _phase_ctx(self, name: str, **args):
+        """One flush phase: the jax.profiler annotation (visible inside an
+        active device profiler trace) stacked with an obs host span (always
+        recorded, exported via export_chrome_trace)."""
+        return _PhasePair(_phase(name), self.obs.tracer.span(f"ytpu.{name}", **args))
+
+    def _finish_flush(self, metrics: dict) -> None:
+        """The single exit point of every flush path: append to the flush
+        ring (which serves last_flush_metrics) + update the registry."""
+        self.obs.record_flush(metrics, row_capacity=self._cap)
+
     def flush(self) -> None:
+        with self.obs.tracer.span("ytpu.flush"):
+            self._flush()
+
+    def _flush(self) -> None:
         t_start = time.perf_counter()
-        with _phase("compact"):
+        with self._phase_ctx("compact"):
             self._maybe_compact()
         t_compact = time.perf_counter()
         plans = {}
@@ -641,7 +684,7 @@ class BatchEngine:
             and any(isinstance(m, NativeMirror) for m in self.mirrors)
         )
         work: list = []  # batched path: (doc, mirror)
-        with _phase("plan"):
+        with self._phase_ctx("plan"):
             if use_batch:
                 for i, m in enumerate(self.mirrors):
                     if i in self.fallback or not isinstance(m, NativeMirror):
@@ -666,29 +709,18 @@ class BatchEngine:
                         self._demote(i, pre_svs.get(i), reason=str(e))
                         demoted_now += 1
         t_plan = time.perf_counter()
-        # one schema for both exits: the normal path overwrites the measured
-        # fields below, so the metrics dict cannot drift between the two
-        metrics = {
-            "n_docs_flushed": 0,
-            "n_demoted": demoted_now,
-            "n_fallback_docs": len(self.fallback),
-            "n_rows_max": 0,
-            "n_sched_entries": 0,
-            "n_levels": 0,
-            "level_width": 0,
-            "schedule_occupancy": 0.0,
-            "n_pending_docs": 0,
-            "pending_depth": 0,
-            "t_compact_s": t_compact - t_start,
-            "t_plan_s": t_plan - t_compact,
-            "t_pack_s": 0.0,
-            "t_dispatch_s": 0.0,
-            "t_emit_s": 0.0,
-            "t_total_s": 0.0,
-        }
+        # ONE schema (obs.FLUSH_METRICS_SCHEMA) for every exit: each path
+        # overwrites only the fields it measures, so the key set cannot
+        # drift between the apply/levels/seq/batched/empty-flush paths
+        metrics = new_flush_metrics(
+            n_demoted=demoted_now,
+            n_fallback_docs=len(self.fallback),
+            t_compact_s=t_compact - t_start,
+            t_plan_s=t_plan - t_compact,
+        )
         if not plans:
             metrics["t_total_s"] = time.perf_counter() - t_start
-            self.last_flush_metrics = metrics
+            self._finish_flush(metrics)
             return
         if use_batch:
             self._flush_apply_batched(
@@ -699,7 +731,7 @@ class BatchEngine:
         if mode == "apply":
             self._flush_apply(plans, pre_svs, emitting, metrics, t_start, t_plan)
             return
-        with _phase("pack"):
+        with self._phase_ctx("pack"):
             n_splits = _bucket(
                 max((len(p.splits) for p in plans.values()), default=0), 1
             )
@@ -752,7 +784,7 @@ class BatchEngine:
             self._upload_statics(plans)
             statics = self._statics
         t_pack = time.perf_counter()
-        with _phase("dispatch"):
+        with self._phase_ctx("dispatch"):
             dyn = (self._right, self._deleted, self._starts)
             if mode == "seq":
                 self._metrics_dev = None  # no sharded counters this flush
@@ -806,7 +838,7 @@ class BatchEngine:
             self._right, self._deleted, self._starts = dyn
         t_dispatch = time.perf_counter()
 
-        with _phase("emit"):
+        with self._phase_ctx("emit"):
             self._emit_phase(plans, pre_svs, emitting)
         t_emit = time.perf_counter()
 
@@ -834,7 +866,7 @@ class BatchEngine:
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
         })
-        self.last_flush_metrics = metrics
+        self._finish_flush(metrics)
 
     def _emit_phase(self, plans, pre_svs, emitting, observed=None) -> None:
         """Post-dispatch host work shared by both dispatch paths: update-log
@@ -913,82 +945,90 @@ class BatchEngine:
         for c0 in range(0, len(work), chunk_sz):
             chunk = work[c0 : c0 + chunk_sz]
             t0 = time.perf_counter()
-            counts_all, rcs, staged_info = prepare_many(
-                chunk,
-                want_levels=False,
-                # events read plan.sched; skip building it otherwise
-                want_sched=bool(self._event_listeners),
-            )
-            chunk_ok: list = []
-            for k, (i, m) in enumerate(chunk):
-                try:
-                    m._finish_prepare(
-                        int(rcs[k]), staged_info[k][0], staged_info[k][1],
-                        counts_all[k],
-                    )
-                except UnsupportedUpdate as e:
-                    self._demote(i, pre_svs.get(i), reason=str(e))
-                    demoted_now += 1
-                else:
-                    chunk_ok.append((i, m, counts_all[k]))
+            with self._phase_ctx("plan", chunk=c0 // chunk_sz,
+                                 docs=len(chunk)):
+                counts_all, rcs, staged_info = prepare_many(
+                    chunk,
+                    want_levels=False,
+                    # events read plan.sched; skip building it otherwise
+                    want_sched=bool(self._event_listeners),
+                    obs=self.obs,
+                )
+                chunk_ok: list = []
+                for k, (i, m) in enumerate(chunk):
+                    try:
+                        m._finish_prepare(
+                            int(rcs[k]), staged_info[k][0], staged_info[k][1],
+                            counts_all[k],
+                        )
+                    except UnsupportedUpdate as e:
+                        self._demote(i, pre_svs.get(i), reason=str(e))
+                        demoted_now += 1
+                    else:
+                        chunk_ok.append((i, m, counts_all[k]))
             t1 = time.perf_counter()
             t_plan_acc += t1 - t0
             if not chunk_ok:
                 continue
-            counts = np.stack([c for _, _, c in chunk_ok])
-            doc_idx = np.asarray([i for i, _, _ in chunk_ok], np.int64)
-            max_rows = int(counts[:, 0].max(initial=0))
-            max_rows_all = max(max_rows_all, max_rows)
-            self._ensure_capacity(max_rows, int(counts[:, 11].max(initial=0)))
-            oob_r = int(self._cap + 1)
-            oob_s = int(self._seg_cap + 1)
-            shard = doc_idx // b_loc
-            link = counts[:, 12]
-            dense = counts[:, 14].astype(bool)
-
-            def shard_max(values, mask, minimum, shard=shard):
-                sums = np.bincount(
-                    shard[mask], weights=values[mask].astype(np.float64),
-                    minlength=n_shards,
+            with self._phase_ctx("pack", chunk=c0 // chunk_sz):
+                counts = np.stack([c for _, _, c in chunk_ok])
+                doc_idx = np.asarray([i for i, _, _ in chunk_ok], np.int64)
+                max_rows = int(counts[:, 0].max(initial=0))
+                max_rows_all = max(max_rows_all, max_rows)
+                self._ensure_capacity(
+                    max_rows, int(counts[:, 11].max(initial=0))
                 )
-                return _bucket_lanes(int(sums.max(initial=0)), minimum)
+                oob_r = int(self._cap + 1)
+                oob_s = int(self._seg_cap + 1)
+                shard = doc_idx // b_loc
+                link = counts[:, 12]
+                dense = counts[:, 14].astype(bool)
 
-            all_mask = np.ones(len(chunk_ok), bool)
-            k_dn = shard_max(link, dense, 64)
-            k_sp = shard_max(link, ~dense, 64)
-            k_h = shard_max(counts[:, 13], all_mask, 8)
-            k_d = shard_max(counts[:, 6], all_mask, 64)
-            # int16 lanes when every index/count fits: half the flush
-            # bytes over the host->device link (the distinct-path
-            # bottleneck on tunneled backends)
-            lane_dtype = (
-                np.int16
-                if max(oob_r, oob_s, int(link.max(initial=0))) <= 32767
-                else np.int32
-            )
-            lanes, stats = pack_apply_lanes(
-                chunk_ok, doc_idx, b_loc, n_shards, (k_dn, k_sp, k_h, k_d),
-                oob_r, oob_s, int(NULL), lane_dtype,
-            )
-            stats_tot += stats
-            # capacity is per shard; real lane counts (stats) sum across
-            # shards, so the denominator must too or meshed runs report
-            # occupancy inflated by n_shards (ADVICE r4)
-            lanes_padded_tot += n_shards * (k_dn + k_sp + k_h + k_d)
-            # the apply path never reads the device statics; mark touched
-            # docs for full (re-)upload if a levels/seq flush ever runs
-            for i, _, _ in chunk_ok:
-                self._uploaded_rows[i] = 0
-            work_ok.extend(chunk_ok)
+                def shard_max(values, mask, minimum, shard=shard):
+                    sums = np.bincount(
+                        shard[mask], weights=values[mask].astype(np.float64),
+                        minlength=n_shards,
+                    )
+                    return _bucket_lanes(int(sums.max(initial=0)), minimum)
+
+                all_mask = np.ones(len(chunk_ok), bool)
+                k_dn = shard_max(link, dense, 64)
+                k_sp = shard_max(link, ~dense, 64)
+                k_h = shard_max(counts[:, 13], all_mask, 8)
+                k_d = shard_max(counts[:, 6], all_mask, 64)
+                # int16 lanes when every index/count fits: half the flush
+                # bytes over the host->device link (the distinct-path
+                # bottleneck on tunneled backends)
+                lane_dtype = (
+                    np.int16
+                    if max(oob_r, oob_s, int(link.max(initial=0))) <= 32767
+                    else np.int32
+                )
+                lanes, stats = pack_apply_lanes(
+                    chunk_ok, doc_idx, b_loc, n_shards,
+                    (k_dn, k_sp, k_h, k_d),
+                    oob_r, oob_s, int(NULL), lane_dtype,
+                )
+                stats_tot += stats
+                # capacity is per shard; real lane counts (stats) sum across
+                # shards, so the denominator must too or meshed runs report
+                # occupancy inflated by n_shards (ADVICE r4)
+                lanes_padded_tot += n_shards * (k_dn + k_sp + k_h + k_d)
+                # the apply path never reads the device statics; mark touched
+                # docs for full (re-)upload if a levels/seq flush ever runs
+                for i, _, _ in chunk_ok:
+                    self._uploaded_rows[i] = 0
+                work_ok.extend(chunk_ok)
             t2 = time.perf_counter()
             t_pack_acc += t2 - t1
             # async dispatch: the device consumes this chunk's lanes while
             # the next loop iteration plans on the host
-            self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
+            with self._phase_ctx("dispatch", chunk=c0 // chunk_sz):
+                self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
             t_disp_acc += time.perf_counter() - t2
         metrics["n_demoted"] = demoted_now
         t_dispatch = time.perf_counter()
-        with _phase("emit"):
+        with self._phase_ctx("emit"):
             # real plan objects only where the emit phase will read them:
             # every doc when update listeners exist, observed docs for
             # events; the log-compaction walk touches keys only.  The
@@ -1034,13 +1074,13 @@ class BatchEngine:
             # out to (1 = serial; YTPU_PLAN_THREADS overrides)
             "plan_threads": _native_plan_threads(),
         })
-        self.last_flush_metrics = metrics
+        self._finish_flush(metrics)
 
     def _flush_apply(self, plans, pre_svs, emitting, metrics, t_start, t_plan):
         """Bulk-apply dispatch: ship the planner's final link/head/delete
         values in ONE conflict-free scatter per array (host-resolved YATA;
         see DocMirror._list_insert / plancore.cpp list_insert)."""
-        with _phase("pack"):
+        with self._phase_ctx("pack"):
             max_rows = max((p.n_rows for p in plans.values()), default=0)
             max_segs = max((self.mirrors[i].n_segs for i in plans), default=0)
             self._ensure_capacity(max_rows, max_segs)
@@ -1129,10 +1169,10 @@ class BatchEngine:
             for i in plans:
                 self._uploaded_rows[i] = 0
         t_pack = time.perf_counter()
-        with _phase("dispatch"):
+        with self._phase_ctx("dispatch"):
             self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
         t_dispatch = time.perf_counter()
-        with _phase("emit"):
+        with self._phase_ctx("emit"):
             self._emit_phase(plans, pre_svs, emitting)
         t_emit = time.perf_counter()
 
@@ -1164,7 +1204,15 @@ class BatchEngine:
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
         })
-        self.last_flush_metrics = metrics
+        self._finish_flush(metrics)
+
+    @property
+    def last_flush_metrics(self) -> dict | None:
+        """Host-side per-phase timers + batch stats of the newest flush —
+        the compatibility view over the obs flush-history ring (the SAME
+        dict object as ``obs.history.latest``; the ring keeps the last
+        ``YTPU_OBS_HISTORY`` flushes)."""
+        return self.obs.history.latest
 
     @property
     def last_metrics(self) -> dict | None:
@@ -1172,6 +1220,27 @@ class BatchEngine:
         if self._metrics_dev is None:
             return None
         return {k: int(v) for k, v in self._metrics_dev.items()}
+
+    # -- observability exposition -------------------------------------------
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition-format dump of the engine registry merged
+        with the process-global one (sync protocol counters)."""
+        return self.obs.metrics_text()
+
+    def metrics_snapshot(self) -> dict:
+        """JSON-able snapshot: registry contents + newest flush metrics +
+        the full flush-history ring."""
+        return self.obs.snapshot()
+
+    def export_chrome_trace(self) -> dict:
+        """Chrome-trace JSON of recorded host spans — loadable by Perfetto
+        / chrome://tracing.  Complements jax.profiler device traces."""
+        return self.obs.tracer.chrome_trace()
+
+    def save_trace(self, path: str) -> str:
+        """Write export_chrome_trace() to ``path``; returns the path."""
+        return self.obs.tracer.save(path)
 
     # -- exports ------------------------------------------------------------
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 
 import numpy as np
 
@@ -706,7 +707,8 @@ class NativeMirror:
         return getattr(self.__dict__["_py"], name)
 
 
-def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
+def prepare_many(work, want_levels: bool = False, want_sched: bool = True,
+                 obs=None):
     """Batched ymx_prepare over many NativeMirrors in ONE native call.
 
     ``work`` is a list of ``(doc_idx, NativeMirror)``.  Returns
@@ -714,6 +716,11 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
     int64 array (ymx_prepare layout + ``[14]`` = dense-link flag),
     ``rcs`` the per-doc return codes, and ``staged_info`` the
     per-doc ``(staged, ids)`` needed by ``_finish_prepare``.
+
+    ``obs`` (an :class:`yjs_tpu.obs.EngineObs`) records each call's wall
+    time and doc count into the ``ytpu_native_prepare_many_*`` histograms
+    — the planner-pool visibility the engine's per-flush timers cannot
+    give once flushes span multiple chunks.
 
     ``want_sched=False`` skips building each plan's sched section
     (``NativePlan.sched`` then reads back empty) — ONLY safe when no
@@ -723,6 +730,7 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
     Replaces the per-doc ctypes round trip that made the host planner
     72% of distinct-doc flush time (BENCH_r03 host_phase_timers).
     """
+    t0 = time.perf_counter()
     n = len(work)
     lib = work[0][1]._lib
     handles = (ctypes.c_void_p * n)()
@@ -791,6 +799,8 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True):
         1 if want_levels else 0, 1 if want_sched else 0, _p64(counts),
         _p64(rcs),
     )
+    if obs is not None:
+        obs.native_prepare(n, time.perf_counter() - t0)
     return counts, rcs, staged_info
 
 

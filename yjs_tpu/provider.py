@@ -57,6 +57,48 @@ class TpuProvider:
         self._undo_settings: dict[str, tuple] = {}
         # memoized attribution views (see user_data)
         self._user_data: dict[tuple[str, str], object] = {}
+        # provider-level counters live on the ENGINE's registry so one
+        # exposition call (metrics_text / metrics_snapshot) covers the
+        # whole stack; all are no-ops under YTPU_OBS_DISABLED=1
+        r = self.engine.obs.registry
+        self._m_updates_rx = r.counter(
+            "ytpu_provider_updates_received_total",
+            "Updates queued via receive_update",
+        )
+        self._m_ingress_bytes = r.counter(
+            "ytpu_provider_update_ingress_bytes_total",
+            "Bytes of update payloads ingested (receive_update + sync "
+            "step2/update frames)",
+            unit="bytes",
+        )
+        self._m_step1 = r.counter(
+            "ytpu_provider_sync_step1_total",
+            "Sync step-1 messages produced (sync_step1)",
+        )
+        self._m_step2 = r.counter(
+            "ytpu_provider_sync_step2_total",
+            "Sync step-2 replies produced (handle_sync_message + batch)",
+        )
+        self._m_step2_bytes = r.counter(
+            "ytpu_provider_sync_step2_bytes_total",
+            "Bytes of framed sync step-2 replies",
+            unit="bytes",
+        )
+        self._m_sync_msgs = r.counter(
+            "ytpu_provider_sync_messages_total",
+            "Sync messages handled by handle_sync_message, by frame type",
+            labelnames=("type",),
+        )
+        self._m_undo = r.counter(
+            "ytpu_provider_undo_total",
+            "Server-side undo-stack operations that reverted something",
+            labelnames=("op",),
+        )
+        self._m_events = r.counter(
+            "ytpu_provider_events_delivered_total",
+            "Observe-bridge events delivered to callbacks (post path "
+            "filter)",
+        )
 
     # -- doc management -----------------------------------------------------
 
@@ -99,6 +141,7 @@ class TpuProvider:
         def bridge(doc, events, g=guid):
             for ev in events:
                 if ev["path"][: len(prefix)] == prefix:
+                    self._m_events.inc()
                     callback(g, ev)
 
         doc = self.doc_id(guid)
@@ -120,6 +163,8 @@ class TpuProvider:
         decides which origins' edits count — reference trackedOrigins,
         UndoManager.js:19-41)."""
         self.engine.queue_update(self.doc_id(guid), update, v2=v2)
+        self._m_updates_rx.inc()
+        self._m_ingress_bytes.inc(len(update))
         self._dirty = True
         ru = self._undo.get(guid)
         if ru is not None:
@@ -191,6 +236,7 @@ class TpuProvider:
         ru = self._room_undo(guid)
         u = ru.undo()
         if u is not None:
+            self._m_undo.labels(op="undo").inc()
             self.engine.queue_update(self.doc_id(guid), u)
             self._dirty = True
             self.flush()
@@ -200,6 +246,7 @@ class TpuProvider:
         ru = self._room_undo(guid)
         u = ru.redo()
         if u is not None:
+            self._m_undo.labels(op="redo").inc()
             self.engine.queue_update(self.doc_id(guid), u)
             self._dirty = True
             self.flush()
@@ -231,6 +278,7 @@ class TpuProvider:
         enc = Encoder()
         encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_1)
         encoding.write_var_uint8_array(enc, self.engine.encode_state_vector(self.doc_id(guid)))
+        self._m_step1.inc()
         return enc.to_bytes()
 
     def handle_sync_message(self, guid: str, message: bytes) -> bytes | None:
@@ -243,6 +291,7 @@ class TpuProvider:
         msg_type = decoding.read_var_uint(dec)
         doc = self.doc_id(guid)
         if msg_type == protocol.MESSAGE_YJS_SYNC_STEP_1:
+            self._m_sync_msgs.labels(type="step1").inc()
             self.flush()
             remote_sv = decoding.read_var_uint8_array(dec)
             enc = Encoder()
@@ -250,9 +299,19 @@ class TpuProvider:
             encoding.write_var_uint8_array(
                 enc, self.engine.encode_state_as_update(doc, remote_sv)
             )
-            return enc.to_bytes()
+            reply = enc.to_bytes()
+            self._m_step2.inc()
+            self._m_step2_bytes.inc(len(reply))
+            return reply
         if msg_type in (protocol.MESSAGE_YJS_SYNC_STEP_2, protocol.MESSAGE_YJS_UPDATE):
-            self.engine.queue_update(doc, decoding.read_var_uint8_array(dec))
+            self._m_sync_msgs.labels(
+                type="step2"
+                if msg_type == protocol.MESSAGE_YJS_SYNC_STEP_2
+                else "update"
+            ).inc()
+            u = decoding.read_var_uint8_array(dec)
+            self._m_ingress_bytes.inc(len(u))
+            self.engine.queue_update(doc, u)
             self._dirty = True
             return None
         raise ValueError(f"unknown sync message type {msg_type}")
@@ -282,6 +341,9 @@ class TpuProvider:
             encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_2)
             encoding.write_var_uint8_array(enc, u)
             replies.append(enc.to_bytes())
+        self._m_sync_msgs.labels(type="step1").inc(len(messages))
+        self._m_step2.inc(len(replies))
+        self._m_step2_bytes.inc(sum(len(rep) for rep in replies))
         return replies
 
     # -- state accessors ----------------------------------------------------
@@ -418,8 +480,37 @@ class TpuProvider:
 
     @property
     def metrics(self) -> dict | None:
-        """Host per-phase timers + batch stats of the last flush."""
-        return self.engine.last_flush_metrics
+        """Host per-phase timers + batch stats of the last flush, as a
+        DEFENSIVE COPY (mutating the returned dict cannot corrupt the
+        engine's flush history; before this was the live dict).
+
+        The key set is stable across every flush mode (apply / levels /
+        seq / ``YTPU_NO_NATIVE_PLAN``) and is exactly
+        ``yjs_tpu.obs.FLUSH_METRICS_SCHEMA``: counts ``n_docs_flushed``,
+        ``n_demoted``, ``n_fallback_docs``, ``n_rows_max``,
+        ``n_sched_entries``, ``n_levels``, ``level_width``,
+        ``n_pending_docs``, ``pending_depth``, ``plan_threads``; the
+        ``schedule_occupancy`` ratio; and the per-phase second timers
+        ``t_compact_s``, ``t_plan_s``, ``t_pack_s``, ``t_dispatch_s``,
+        ``t_emit_s``, ``t_total_s``.  ``None`` before the first flush."""
+        m = self.engine.last_flush_metrics
+        return None if m is None else dict(m)
+
+    @property
+    def metrics_history(self) -> list[dict]:
+        """Per-flush metric dicts, oldest to newest (copies), for the last
+        ``YTPU_OBS_HISTORY`` flushes."""
+        return self.engine.obs.history.snapshot()
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition-format dump of the whole stack: provider
+        counters, engine flush metrics, sync-protocol frame counters."""
+        return self.engine.metrics_text()
+
+    def metrics_snapshot(self) -> dict:
+        """JSON-able snapshot of the whole stack (see
+        BatchEngine.metrics_snapshot)."""
+        return self.engine.metrics_snapshot()
 
 
 class RoomUndoHandle:

@@ -18,37 +18,62 @@ from ..core import Doc
 from ..lib0 import decoding, encoding
 from ..lib0.decoding import Decoder
 from ..lib0.encoding import Encoder
+from ..obs import global_registry
 from ..updates import apply_update, encode_state_as_update, encode_state_vector
 
 MESSAGE_YJS_SYNC_STEP_1 = 0
 MESSAGE_YJS_SYNC_STEP_2 = 1
 MESSAGE_YJS_UPDATE = 2
 
+_TYPE_NAMES = {
+    MESSAGE_YJS_SYNC_STEP_1: "step1",
+    MESSAGE_YJS_SYNC_STEP_2: "step2",
+    MESSAGE_YJS_UPDATE: "update",
+}
+
+# per-frame counters live on the process-global registry (these are free
+# functions with no engine handle); engine/provider exposition merges it
+_frames = global_registry().get("ytpu_sync_messages_total")
+
+
+def _count(direction: str, message_type: int) -> None:
+    if _frames is not None:
+        _frames.labels(dir=direction, type=_TYPE_NAMES[message_type]).inc()
+
 
 def write_sync_step1(encoder: Encoder, doc: Doc) -> None:
     encoding.write_var_uint(encoder, MESSAGE_YJS_SYNC_STEP_1)
     encoding.write_var_uint8_array(encoder, encode_state_vector(doc))
+    _count("write", MESSAGE_YJS_SYNC_STEP_1)
 
 
 def write_sync_step2(encoder: Encoder, doc: Doc, encoded_state_vector: bytes | None = None) -> None:
     encoding.write_var_uint(encoder, MESSAGE_YJS_SYNC_STEP_2)
     encoding.write_var_uint8_array(encoder, encode_state_as_update(doc, encoded_state_vector))
+    _count("write", MESSAGE_YJS_SYNC_STEP_2)
 
 
 def read_sync_step1(decoder: Decoder, encoder: Encoder, doc: Doc) -> None:
+    _count("read", MESSAGE_YJS_SYNC_STEP_1)
     write_sync_step2(encoder, doc, decoding.read_var_uint8_array(decoder))
 
 
 def read_sync_step2(decoder: Decoder, doc: Doc, transaction_origin=None) -> None:
+    _count("read", MESSAGE_YJS_SYNC_STEP_2)
     apply_update(doc, decoding.read_var_uint8_array(decoder), transaction_origin)
 
 
 def write_update(encoder: Encoder, update: bytes) -> None:
     encoding.write_var_uint(encoder, MESSAGE_YJS_UPDATE)
     encoding.write_var_uint8_array(encoder, update)
+    _count("write", MESSAGE_YJS_UPDATE)
 
 
-read_update_message = read_sync_step2
+def read_update_message(decoder: Decoder, doc: Doc, transaction_origin=None) -> None:
+    """Same wire handling as read_sync_step2 (an update IS a partial
+    step-2 payload); counted separately so frame-type traffic is visible."""
+    _count("read", MESSAGE_YJS_UPDATE)
+    apply_update(doc, decoding.read_var_uint8_array(decoder), transaction_origin)
 
 
 def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_origin=None) -> int:
