@@ -602,6 +602,54 @@ def write_obs_artifacts(eng) -> dict:
     return out
 
 
+def bench_resilience(n_ops: int = 200) -> dict:
+    """Failure-isolation overhead: the same flush clean vs with one
+    poisoned doc.  The rollback path (validate the update log, strip the
+    bad bytes to the dead-letter queue, replay the survivors into a CPU
+    doc) bills only the failing doc — the other n-1 docs should pay
+    nothing measurable."""
+    import gc
+
+    from yjs_tpu.ops import BatchEngine
+
+    n_docs = int(os.environ.get("YTPU_BENCH_RESILIENCE_DOCS", "64"))
+    updates = load_distinct_traces(n_docs, n_ops)
+    bad = n_docs // 2
+    poison = b"\xff\xff\xff\xff\xff"
+
+    def run(poisoned: bool, runs: int = 3):
+        times, snap = [], None
+        for _ in range(runs):
+            gc.collect()
+            eng = BatchEngine(n_docs)
+            t0 = time.perf_counter()
+            for i, u in enumerate(updates):
+                eng.queue_update(i, u)
+            if poisoned:
+                eng.queue_update(bad, poison)
+            eng.flush()
+            np.asarray(eng._right[:, 0])
+            times.append(time.perf_counter() - t0)
+            snap = eng.resilience_snapshot()
+            eng = None
+        times.sort()
+        return times[len(times) // 2], snap
+
+    t_clean, _ = run(False)  # also warms the compile cache
+    t_poison, snap = run(True)
+    return {
+        "n_docs": n_docs,
+        "trace_ops": n_ops,
+        "clean_flush_s": round(t_clean, 4),
+        "poisoned_flush_s": round(t_poison, 4),
+        "isolation_overhead_s": round(t_poison - t_clean, 4),
+        "isolation_overhead_pct": (
+            round(100 * (t_poison - t_clean) / t_clean, 1) if t_clean else 0
+        ),
+        "snapshot": snap,
+    }
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -649,6 +697,8 @@ def main():
     )
     time.sleep(3)
     b4 = bench_b4_broadcast(n_docs_b4)
+    time.sleep(3)
+    resilience = bench_resilience()
     sweep = (
         sweep_distinct(n_ops)
         if os.environ.get("YTPU_BENCH_SWEEP")
@@ -699,6 +749,7 @@ def main():
                 2,
             ),
             "obs": obs_summary,
+            "resilience": resilience,
         },
     }
     if sweep is not None:

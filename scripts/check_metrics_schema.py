@@ -5,8 +5,10 @@ Instantiates a provider (which registers every engine + provider metric
 family at construction) plus the process-global registry, extracts the
 ``ytpu_*`` names from the README Observability table, and fails when
 either side has a name the other lacks — so the docs and the exposition
-surface cannot drift apart.  Wired as a tier-1 check via
-tests/test_obs.py-adjacent usage and runnable standalone:
+surface cannot drift apart.  Also cross-checks the resilience/chaos env
+knobs (``YTPU_CHAOS_*`` / ``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*``) read
+by the code against the knobs README documents.  Wired as a tier-1
+check via tests/test_obs.py-adjacent usage and runnable standalone:
 
     python scripts/check_metrics_schema.py
 """
@@ -42,8 +44,24 @@ def registered_names() -> set[str]:
     )
 
 
+_KNOB_RE = re.compile(r"YTPU_(?:CHAOS|RESILIENCE|DLQ)_[A-Z0-9_]+")
+
+
+def resilience_knobs_in_code() -> set[str]:
+    """Resilience/chaos env names the package actually reads."""
+    knobs: set[str] = set()
+    for path in (ROOT / "yjs_tpu").rglob("*.py"):
+        knobs |= set(_KNOB_RE.findall(path.read_text()))
+    return knobs
+
+
+def resilience_knobs_in_readme(readme_text: str) -> set[str]:
+    return set(_KNOB_RE.findall(readme_text))
+
+
 def main() -> int:
-    doc = documented_names((ROOT / "README.md").read_text())
+    readme = (ROOT / "README.md").read_text()
+    doc = documented_names(readme)
     live = registered_names()
     if not live:
         print("obs disabled (YTPU_OBS_DISABLED) — nothing to check")
@@ -58,9 +76,24 @@ def main() -> int:
         print("documented in README but NOT registered:")
         for n in stale:
             print(f"  {n}")
-    if undocumented or stale:
+    code_knobs = resilience_knobs_in_code()
+    doc_knobs = resilience_knobs_in_readme(readme)
+    knob_undoc = sorted(code_knobs - doc_knobs)
+    knob_stale = sorted(doc_knobs - code_knobs)
+    if knob_undoc:
+        print("env knobs read by the code but NOT in README:")
+        for n in knob_undoc:
+            print(f"  {n}")
+    if knob_stale:
+        print("env knobs in README but NOT read by the code:")
+        for n in knob_stale:
+            print(f"  {n}")
+    if undocumented or stale or knob_undoc or knob_stale:
         return 1
-    print(f"ok: {len(live)} metric families, docs and registry agree")
+    print(
+        f"ok: {len(live)} metric families and {len(code_knobs)} "
+        "resilience env knobs, docs and code agree"
+    )
     return 0
 
 

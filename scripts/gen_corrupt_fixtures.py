@@ -1,0 +1,161 @@
+"""Generate the corrupt-update fixture set for the resilience suite.
+
+Takes small valid V1 updates built from B4-style editing traffic (same
+texture as scripts/gen_b4_fixture.py, tiny scale) and damages them the
+ways transports and disks actually do: single bit flips, truncations at
+varint/struct boundaries, and varint overflows (continuation-bit runs
+that inflate a length/count field past any plausible buffer).
+
+Every corrupt payload is VERIFIED rejected by
+``yjs_tpu.updates.validate_update`` before it is written — a corruption
+that still decodes is a Byzantine input, out of scope for the quarantine
+tests (see yjs_tpu/resilience/chaos.py's detectability contract).
+
+Writes, under tests/fixtures/corrupt/:
+
+- ``manifest.json`` — schema version, generator seed, and one record per
+  case: file name, corruption kind, source update length, and notes;
+- ``<case>.bin`` — the corrupt bytes;
+- ``valid_base.bin`` — the clean source update the cases derive from
+  (lets tests assert the uncorrupted twin still integrates).
+
+Usage: python scripts/gen_corrupt_fixtures.py [seed]
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import yjs_tpu as Y
+from yjs_tpu.updates import InvalidUpdate, validate_update
+
+SCHEMA_VERSION = 1
+OUT_DIR = Path(__file__).resolve().parent.parent / "tests" / "fixtures" / "corrupt"
+
+
+def base_update(seed: int) -> bytes:
+    """A small multi-client V1 update with inserts AND deletes (so the
+    DS section is non-empty and truncations can land inside it)."""
+    gen = random.Random(seed)
+    a = Y.Doc(gc=False)
+    a.client_id = 11
+    b = Y.Doc(gc=False)
+    b.client_id = 22
+    for k in range(40):
+        d = a if gen.random() < 0.6 else b
+        t = d.get_text("text")
+        if t and gen.random() < 0.3:
+            t.delete(gen.randrange(len(t)), 1)
+        else:
+            pos = gen.randrange(len(t) + 1)
+            t.insert(pos, gen.choice("abcdefgh "))
+        if k % 10 == 9:
+            ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+            ub = Y.encode_state_as_update(b, Y.encode_state_vector(a))
+            Y.apply_update(b, ua)
+            Y.apply_update(a, ub)
+    ua = Y.encode_state_as_update(a, Y.encode_state_vector(b))
+    Y.apply_update(b, ua)
+    return Y.encode_state_as_update(b)
+
+
+def bit_flips(update: bytes, gen: random.Random, want: int = 6) -> list[tuple[bytes, str]]:
+    """``want`` distinct single-bit flips, each verified invalid."""
+    out = []
+    tried = set()
+    while len(out) < want and len(tried) < 8 * len(update):
+        i = gen.randrange(len(update))
+        bit = gen.randrange(8)
+        if (i, bit) in tried:
+            continue
+        tried.add((i, bit))
+        cand = bytearray(update)
+        cand[i] ^= 1 << bit
+        cand = bytes(cand)
+        try:
+            validate_update(cand)
+        except InvalidUpdate:
+            out.append((cand, f"bit {bit} of byte {i} flipped"))
+    return out
+
+
+def truncations(update: bytes, gen: random.Random, want: int = 6) -> list[tuple[bytes, str]]:
+    cuts = {0, 1, len(update) // 2, len(update) - 1}
+    while len(cuts) < want + 4:
+        cuts.add(gen.randrange(len(update)))
+    out = []
+    for cut in sorted(cuts):
+        cand = update[:cut]
+        try:
+            validate_update(cand)
+        except InvalidUpdate:
+            out.append((cand, f"cut to {cut} of {len(update)} bytes"))
+        if len(out) >= want:
+            break
+    return out
+
+
+def varint_overflows(update: bytes) -> list[tuple[bytes, str]]:
+    """Inflate varints the decoder trusts for sizing/counting."""
+    return [
+        # leading client-count varint inflated to ~2**63: the struct
+        # loop exhausts the buffer long before reading that many
+        (b"\xff" * 9 + update, "client-count varint inflated (9 cont. bytes)"),
+        # a varint that never terminates (every byte continues)
+        (b"\xff" * len(update), "all-continuation-bytes varint, no terminator"),
+        # plausible-looking count with no structs behind it
+        (b"\x7f" + update[1:2], "count 127 then immediate end of buffer"),
+    ]
+
+
+def main(seed: int = 13) -> None:
+    gen = random.Random(seed)
+    update = base_update(seed)
+    validate_update(update)  # the base MUST be clean
+
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    (OUT_DIR / "valid_base.bin").write_bytes(update)
+
+    cases = []
+    kinds = (
+        [("bitflip", c, note) for c, note in bit_flips(update, gen)]
+        + [("truncation", c, note) for c, note in truncations(update, gen)]
+        + [("varint_overflow", c, note) for c, note in varint_overflows(update)]
+    )
+    for n, (kind, payload, note) in enumerate(kinds):
+        try:
+            validate_update(payload)
+        except InvalidUpdate as e:
+            reason = f"{type(e).__name__}"
+        else:
+            raise SystemExit(
+                f"case {kind}/{note} decodes as valid — Byzantine, refuse to write"
+            )
+        name = f"{kind}_{n:02d}.bin"
+        (OUT_DIR / name).write_bytes(payload)
+        cases.append({
+            "file": name,
+            "kind": kind,
+            "bytes": len(payload),
+            "source_bytes": len(update),
+            "note": note,
+            "rejected_as": reason,
+        })
+
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "seed": seed,
+        "base": {"file": "valid_base.bin", "bytes": len(update)},
+        "cases": cases,
+    }
+    (OUT_DIR / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    print(f"wrote {len(cases)} corrupt cases + base to {OUT_DIR}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 13)

@@ -36,6 +36,20 @@ if "jax" in sys.modules:
         pass
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini) so -W error runs stay clean:
+    # "slow" gates long soak tests out of tier-1 (-m 'not slow');
+    # "chaos" tags the fault-injection convergence suite — in tier-1 by
+    # default (deterministic seeds), deselectable with -m 'not chaos'
+    config.addinivalue_line(
+        "markers", "slow: long soak tests excluded from tier-1"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection convergence tests",
+    )
+
+
 @pytest.fixture
 def rng(request):
     """Deterministic per-test PRNG; vary YTPU_TEST_SEED for new random runs

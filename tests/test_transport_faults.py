@@ -1,0 +1,111 @@
+"""Property-style transport-fault tests (ISSUE 2 satellite): updates
+duplicated, reordered, and redelivered across providers always converge
+to identical ``text()`` / state vectors — the CRDT idempotency and
+commutativity contract (reference README.md:650-652) holds through the
+provider/engine batch path, not just the CPU core.
+
+Randomness comes from the deterministic per-test ``rng`` fixture
+(conftest.py): failures reproduce, new YTPU_TEST_SEED values explore new
+schedules."""
+
+import yjs_tpu as Y
+from yjs_tpu.provider import TpuProvider
+
+ROOM = "r"
+
+
+def _edit_stream(rng, n_ops=50, n_clients=3):
+    """Incremental per-op updates from independent clients + the oracle
+    text they merge to."""
+    docs = []
+    updates = []
+    for k in range(n_clients):
+        d = Y.Doc(gc=False)
+        d.client_id = 7000 + k
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        docs.append(d)
+    for _ in range(n_ops):
+        d = rng.choice(docs)
+        t = d.get_text("text")
+        if len(t) and rng.random() < 0.3:
+            t.delete(rng.randrange(len(t)), 1)
+        else:
+            t.insert(rng.randrange(len(t) + 1), rng.choice("abcdefgh "))
+    oracle = Y.Doc(gc=False)
+    for u in updates:
+        Y.apply_update(oracle, u)
+    return updates, str(oracle.get_text("text"))
+
+
+def _settle(p):
+    """Flush until parked (causally unready) traffic stops resolving."""
+    for _ in range(8):
+        p.flush()
+        if not p.engine.has_pending(p.doc_id(ROOM)):
+            break
+    return p.text(ROOM)
+
+
+def test_duplicated_updates_converge(rng):
+    updates, oracle = _edit_stream(rng)
+    pa, pb = TpuProvider(1), TpuProvider(1)
+    for u in updates:
+        for _ in range(rng.randrange(1, 4)):  # deliver 1-3 copies
+            pa.receive_update(ROOM, u)
+        pb.receive_update(ROOM, u)
+    assert _settle(pa) == oracle
+    assert _settle(pb) == oracle
+    assert pa.state_vector(ROOM) == pb.state_vector(ROOM)
+
+
+def test_reordered_updates_converge(rng):
+    updates, oracle = _edit_stream(rng)
+    shuffled = list(updates)
+    rng.shuffle(shuffled)
+    pa, pb = TpuProvider(1), TpuProvider(1)
+    for u in shuffled:
+        pa.receive_update(ROOM, u)
+    for u in updates:
+        pb.receive_update(ROOM, u)
+    assert _settle(pa) == oracle
+    assert _settle(pb) == oracle
+    assert pa.state_vector(ROOM) == pb.state_vector(ROOM)
+
+
+def test_redelivered_after_flush_converges(rng):
+    """Redelivery of ALREADY-INTEGRATED updates (at-least-once
+    transports) is a no-op, including interleaved with fresh traffic."""
+    updates, oracle = _edit_stream(rng)
+    p = TpuProvider(1)
+    seen = []
+    for u in updates:
+        p.receive_update(ROOM, u)
+        seen.append(u)
+        if rng.random() < 0.2:
+            p.flush()
+            for old in rng.sample(seen, min(len(seen), 5)):
+                p.receive_update(ROOM, old)
+    # full redelivery storm at the end
+    for u in rng.sample(updates, len(updates)):
+        p.receive_update(ROOM, u)
+    assert _settle(p) == oracle
+
+
+def test_mixed_schedules_cross_converge(rng):
+    """Every provider sees the same updates under a DIFFERENT schedule
+    (order, duplication, flush points) — all end byte-identical."""
+    updates, oracle = _edit_stream(rng)
+    provs = [TpuProvider(1) for _ in range(3)]
+    for p in provs:
+        sched = list(updates)
+        rng.shuffle(sched)
+        for u in sched:
+            p.receive_update(ROOM, u)
+            if rng.random() < 0.5:
+                p.receive_update(ROOM, u)  # immediate duplicate
+            if rng.random() < 0.1:
+                p.flush()
+    texts = [_settle(p) for p in provs]
+    assert texts == [oracle] * 3
+    svs = [p.state_vector(ROOM) for p in provs]
+    assert svs[0] == svs[1] == svs[2]

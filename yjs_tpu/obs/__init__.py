@@ -43,6 +43,9 @@ SNAPSHOT_SCHEMA_VERSION = 1
 FLUSH_METRICS_SCHEMA: dict = {
     "n_docs_flushed": 0,
     "n_demoted": 0,
+    # docs transactionally rolled back (and demoted) by failure
+    # isolation during this flush — always <= n_demoted
+    "n_rolled_back": 0,
     "n_fallback_docs": 0,
     "n_rows_max": 0,
     "n_sched_entries": 0,
@@ -101,6 +104,11 @@ def global_registry() -> MetricsRegistry:
             "ytpu_sync_messages_total",
             "y-protocols sync frames processed by yjs_tpu.sync.protocol",
             labelnames=("dir", "type"),
+        )
+        _GLOBAL.counter(
+            "ytpu_chaos_faults_total",
+            "Faults injected by the chaos harness, by fault kind",
+            labelnames=("fault",),
         )
     return _GLOBAL
 
@@ -186,6 +194,40 @@ class EngineObs:
             "Docs planned per ymx_prepare_many call",
             unit="docs",
         )
+        self._rollbacks = r.counter(
+            "ytpu_resilience_rollbacks_total",
+            "Per-doc transactional flush rollbacks by reason",
+            labelnames=("reason",),
+        )
+        self._dead_letters = r.counter(
+            "ytpu_resilience_dead_letters_total",
+            "Updates diverted to the dead-letter queue by reason",
+            labelnames=("reason",),
+        )
+        self._dlq_depth = r.gauge(
+            "ytpu_resilience_dead_letter_depth",
+            "Dead letters currently held in the bounded queue",
+        )
+        self._dlq_dropped = r.counter(
+            "ytpu_resilience_dead_letters_dropped_total",
+            "Dead letters evicted (oldest-first) by the capacity bound",
+        )
+        self._docs_degraded = r.gauge(
+            "ytpu_resilience_docs_degraded",
+            "Docs currently in the degraded health state",
+        )
+        self._docs_quarantined = r.gauge(
+            "ytpu_resilience_docs_quarantined",
+            "Docs currently quarantined (traffic diverted to dead letters)",
+        )
+        self._readmissions = r.counter(
+            "ytpu_resilience_readmissions_total",
+            "Quarantined docs re-admitted after backoff expiry",
+        )
+        self._replayed = r.counter(
+            "ytpu_resilience_replayed_total",
+            "Dead letters successfully re-integrated by replay()",
+        )
 
     # -- hot-path recording hooks -------------------------------------
 
@@ -223,6 +265,44 @@ class EngineObs:
             return
         self._native_prepare_seconds.observe(dt_s)
         self._native_prepare_docs.observe(n_docs)
+
+    # -- resilience hooks ----------------------------------------------
+
+    def rollback(self, doc: int, reason: str) -> None:
+        if not self.enabled:
+            return
+        self._rollbacks.labels(reason=reason).inc()
+        self.tracer.instant("ytpu.rollback", doc=doc, reason=reason)
+
+    def dead_lettered(self, reason: str, depth: int, dropped: int) -> None:
+        if not self.enabled:
+            return
+        # group by the reason's stable prefix so a poison storm with
+        # per-byte exception detail cannot explode label cardinality
+        self._dead_letters.labels(reason=reason.split(":", 1)[0]).inc()
+        self._dlq_depth.set(depth)
+        # `dropped` is the queue's monotonic total; counters only inc,
+        # so mirror the delta since the last call
+        seen = getattr(self, "_dlq_dropped_seen", 0)
+        if dropped > seen:
+            self._dlq_dropped.inc(dropped - seen)
+            self._dlq_dropped_seen = dropped
+
+    def health_gauges(self, degraded: int, quarantined: int) -> None:
+        if not self.enabled:
+            return
+        self._docs_degraded.set(degraded)
+        self._docs_quarantined.set(quarantined)
+
+    def readmitted(self) -> None:
+        if not self.enabled:
+            return
+        self._readmissions.inc()
+
+    def replayed(self, n: int) -> None:
+        if not self.enabled or n <= 0:
+            return
+        self._replayed.inc(n)
 
     # -- exposition ----------------------------------------------------
 

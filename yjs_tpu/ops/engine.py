@@ -30,6 +30,8 @@ except Exception:  # pragma: no cover
 from ..core import Doc
 from ..lib0.u16 import from_u16
 from ..obs import EngineObs, new_flush_metrics
+from ..resilience import DeadLetterQueue, HealthTracker
+from ..updates import InvalidUpdate, validate_update
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
 from .native_mirror import (
@@ -275,6 +277,15 @@ class BatchEngine:
         # every flush live in obs.history; last_flush_metrics is the
         # compatibility view of the newest entry)
         self.obs = EngineObs()
+        # resilience (ISSUE 2): per-doc failure isolation.  Strict mode
+        # (YTPU_RESILIENCE_DISABLED=1) restores the pre-resilience
+        # contract — integration failures raise out of flush()
+        self._strict = os.environ.get("YTPU_RESILIENCE_DISABLED") == "1"
+        self.health = HealthTracker(obs=self.obs)
+        self.dead_letters = DeadLetterQueue()
+        # every transactional per-doc rollback, with its reason (the
+        # rollback subset of self.demotions)
+        self.rollbacks: list[dict] = []
         self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
         # persistent device state (no left-link array: order is ranked from
         # right links with a host-known membership mask)
@@ -291,16 +302,47 @@ class BatchEngine:
 
     # -- update ingestion ---------------------------------------------------
 
-    def queue_update(self, doc: int, update: bytes, v2: bool = False) -> None:
+    def queue_update(self, doc: int, update: bytes, v2: bool = False) -> bool:
+        """Queue one update for ``doc``; returns True when accepted.
+
+        False means the bytes were diverted to :attr:`dead_letters`
+        instead of entering the pipeline: the doc is quarantined, or (on
+        the CPU-served path, where apply is immediate) the update failed
+        to apply.  Callers that track dirtiness should only mark dirty
+        on True."""
+        if (
+            not self._strict
+            and self.health.tracked
+            and not self.health.admissible(doc)
+        ):
+            self._dead_letter(doc, update, v2, "quarantined")
+            return False
         fb = self.fallback.get(doc)
         if fb is None and self.policy == "cpu":
             fb = self._cpu_serve(doc)
         if fb is not None:
             # CPU-served docs apply directly; the log is dead weight for them
-            (apply_update_v2 if v2 else apply_update)(fb, update)
+            try:
+                (apply_update_v2 if v2 else apply_update)(fb, update)
+            except Exception as e:
+                if self._strict:
+                    raise
+                reason = f"cpu-apply: {type(e).__name__}: {e}"
+                self._dead_letter(doc, update, v2, reason)
+                self.health.record_failure(doc, reason)
+                return False
+            if self.health.tracked:
+                self.health.record_success(doc)
         else:
             self._update_log[doc].append((update, v2))
             self.mirrors[doc].ingest(update, v2)
+        return True
+
+    def _dead_letter(self, doc: int, update: bytes, v2: bool, reason: str) -> None:
+        self.dead_letters.append(doc, update, v2, reason)
+        self.obs.dead_lettered(
+            reason, len(self.dead_letters), self.dead_letters.dropped
+        )
 
     def _cpu_serve(self, doc: int) -> Doc:
         """Route a doc to the CPU reference core by configuration (policy
@@ -414,7 +456,17 @@ class BatchEngine:
                 if all(sv.get(c, 0) >= v for c, v in pre_sv.items()):
                     self._attach_cpu_events(doc, fb)
                     attached = True
-            (apply_update_v2 if v2 else apply_update)(fb, update)
+            try:
+                (apply_update_v2 if v2 else apply_update)(fb, update)
+            except Exception as e:
+                # a log entry even the CPU reference core rejects cannot
+                # be replayed anywhere: keep the bytes recoverable and
+                # finish the demotion with the entries that do apply
+                if self._strict:
+                    raise
+                self._dead_letter(
+                    doc, update, v2, f"replay: {type(e).__name__}: {e}"
+                )
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
         self._update_log[doc] = []
@@ -438,6 +490,81 @@ class BatchEngine:
         if doc in self._event_listeners:
             self._attach_cpu_events(doc, fb)
         return fb
+
+    def _isolate_failure(self, doc: int, exc: Exception, pre_sv=None) -> None:
+        """Transactional per-doc rollback: contain one doc's failed
+        integration without touching the rest of the batch.
+
+        The update log is the transaction journal — every entry is
+        re-validated, malformed entries are stripped to the dead-letter
+        queue (bytes + reason preserved), and :meth:`_demote` replays
+        the surviving prefix into a fresh CPU doc.  That replay IS the
+        rollback: it rebuilds the doc's last good state, and replacing
+        the mirror discards whatever poison its ``_incoming`` held, so
+        the failure cannot re-wedge later flushes."""
+        reason = f"{type(exc).__name__}: {exc}"
+        clean: list[tuple[bytes, bool]] = []
+        for update, v2 in self._update_log[doc]:
+            try:
+                validate_update(update, v2)
+            except InvalidUpdate as ve:
+                self._dead_letter(doc, update, v2, f"invalid-update: {ve}")
+            else:
+                clean.append((update, v2))
+        self._update_log[doc] = clean
+        self.rollbacks.append({"doc": doc, "reason": reason})
+        self.obs.rollback(doc, reason)
+        self.health.record_failure(doc, reason)
+        self._demote(doc, pre_sv, reason=f"rollback: {reason}")
+
+    def replay_dead_letters(
+        self, doc: int | None = None, seqs=None, repair=None,
+        readmit: bool = False,
+    ) -> dict:
+        """Re-inject dead letters through the normal ingestion path.
+
+        ``repair`` is an optional ``callable(DeadLetter) -> bytes | None``
+        applied first: return fixed bytes to replay, or None to leave
+        the letter queued (counted as requeued).  ``readmit=True``
+        clears the targeted docs' health records first (operator
+        override of quarantine backoff).  Letters that still fail
+        validation or admission are re-dead-lettered and counted as
+        failed.  Returns ``{"replayed", "requeued", "failed"}``."""
+        if readmit:
+            self.health.reset(doc)
+        replayed = requeued = failed = 0
+        for e in self.dead_letters.take(doc=doc, seqs=seqs):
+            update = e.update
+            if repair is not None:
+                fixed = repair(e)
+                if fixed is None:
+                    self.dead_letters.append(e.doc, e.update, e.v2, e.reason)
+                    requeued += 1
+                    continue
+                update = bytes(fixed)
+            try:
+                validate_update(update, e.v2)
+            except InvalidUpdate as ve:
+                self._dead_letter(e.doc, update, e.v2, f"replay-invalid: {ve}")
+                failed += 1
+                continue
+            if self.queue_update(e.doc, update, e.v2):
+                replayed += 1
+            else:
+                failed += 1  # inadmissible: re-dead-lettered by queue_update
+        self.obs.replayed(replayed)
+        return {"replayed": replayed, "requeued": requeued, "failed": failed}
+
+    def resilience_snapshot(self) -> dict:
+        """JSON-able view of the failure-isolation state (bench/expo)."""
+        return {
+            "strict": self._strict,
+            "health": self.health.summary(),
+            "docs": self.health.records(),
+            "dead_letters": self.dead_letters.snapshot(),
+            "n_rollbacks": len(self.rollbacks),
+            "n_demotions": len(self.demotions),
+        }
 
     # -- device placement ---------------------------------------------------
 
@@ -654,6 +781,9 @@ class BatchEngine:
     def flush(self) -> None:
         with self.obs.tracer.span("ytpu.flush"):
             self._flush()
+            # one flush = one health-clock tick (quarantine backoff is
+            # counted in flushes, keeping re-admission deterministic)
+            self.health.tick()
 
     def _flush(self) -> None:
         t_start = time.perf_counter()
@@ -663,6 +793,7 @@ class BatchEngine:
         plans = {}
         pre_svs: dict[int, dict[int, int]] = {}
         demoted_now = 0
+        rolled_back = 0
         emitting = bool(self._update_listeners)
         observing = self._event_listeners
         # kernel selection: "apply" (default, meshed or not) ships the
@@ -708,12 +839,22 @@ class BatchEngine:
                     except UnsupportedUpdate as e:
                         self._demote(i, pre_svs.get(i), reason=str(e))
                         demoted_now += 1
+                    except Exception as e:
+                        # malformed bytes (or any integration fault):
+                        # roll back and contain THIS doc; the rest of
+                        # the batch flushes normally
+                        if self._strict:
+                            raise
+                        self._isolate_failure(i, e, pre_svs.get(i))
+                        demoted_now += 1
+                        rolled_back += 1
         t_plan = time.perf_counter()
         # ONE schema (obs.FLUSH_METRICS_SCHEMA) for every exit: each path
         # overwrites only the fields it measures, so the key set cannot
         # drift between the apply/levels/seq/batched/empty-flush paths
         metrics = new_flush_metrics(
             n_demoted=demoted_now,
+            n_rolled_back=rolled_back,
             n_fallback_docs=len(self.fallback),
             t_compact_s=t_compact - t_start,
             t_plan_s=t_plan - t_compact,
@@ -874,6 +1015,10 @@ class BatchEngine:
         device execution).  ``observed`` restricts event computation to a
         prepare-time listener snapshot (the batched path may not have
         built plan.sched for docs unobserved at prepare)."""
+        if self.health.tracked:
+            # every doc that reached emit integrated cleanly this flush
+            for i in plans:
+                self.health.record_success(i)
         for i in plans:
             m = self.mirrors[i]
             if len(self._update_log[i]) > 64 and not m.has_pending():
@@ -941,6 +1086,7 @@ class BatchEngine:
         lanes_padded_tot = 0
         work_ok: list = []  # (doc, mirror, counts-row) across all chunks
         demoted_now = metrics["n_demoted"]
+        rolled_back = metrics["n_rolled_back"]
         max_rows_all = 0
         for c0 in range(0, len(work), chunk_sz):
             chunk = work[c0 : c0 + chunk_sz]
@@ -964,6 +1110,12 @@ class BatchEngine:
                     except UnsupportedUpdate as e:
                         self._demote(i, pre_svs.get(i), reason=str(e))
                         demoted_now += 1
+                    except Exception as e:
+                        if self._strict:
+                            raise
+                        self._isolate_failure(i, e, pre_svs.get(i))
+                        demoted_now += 1
+                        rolled_back += 1
                     else:
                         chunk_ok.append((i, m, counts_all[k]))
             t1 = time.perf_counter()
@@ -1027,6 +1179,7 @@ class BatchEngine:
                 self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
             t_disp_acc += time.perf_counter() - t2
         metrics["n_demoted"] = demoted_now
+        metrics["n_rolled_back"] = rolled_back
         t_dispatch = time.perf_counter()
         with self._phase_ctx("emit"):
             # real plan objects only where the emit phase will read them:

@@ -486,10 +486,19 @@ def list_ranks(right_link, valid):
     d = jnp.where(right_link != NULL, 1, 0).astype(jnp.int32)
     p = right_link
     n_rounds = max(1, math.ceil(math.log2(max(2, n1))))
-    for _ in range(n_rounds):
+
+    # fori_loop rather than a Python-unrolled loop: unrolling log2(N) gather
+    # rounds makes HLO size (and XLA:CPU compile time) grow superlinearly
+    # with row capacity — ~80s at N=8192 on one host core, which stalled the
+    # suite on wide docs.  The rolled loop compiles in constant time.
+    def _round(_, dp):
+        d, p = dp
         safe_p = jnp.where(p != NULL, p, 0)
         d = d + jnp.where(p != NULL, jnp.take_along_axis(d, safe_p, axis=1), 0)
         p = jnp.where(p != NULL, jnp.take_along_axis(p, safe_p, axis=1), NULL)
+        return d, p
+
+    d, _ = jax.lax.fori_loop(0, n_rounds, _round, (d, p))
     return jnp.where(valid, d, NULL)
 
 

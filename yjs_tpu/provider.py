@@ -18,6 +18,7 @@ from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
 from .ops.engine import BatchEngine
 from .sync import protocol
+from .updates import validate_update
 
 
 class TpuProvider:
@@ -157,18 +158,27 @@ class TpuProvider:
     def receive_update(
         self, guid: str, update: bytes, v2: bool = False,
         undoable: bool = False,
-    ) -> None:
+    ) -> bool:
         """Queue one room update.  ``undoable=True`` marks it for the
         room's undo stack when :meth:`enable_undo` is active (the server
         decides which origins' edits count — reference trackedOrigins,
-        UndoManager.js:19-41)."""
-        self.engine.queue_update(self.doc_id(guid), update, v2=v2)
+        UndoManager.js:19-41).
+
+        Returns True when the update was accepted.  False means it was
+        diverted to the engine's dead-letter queue instead (the room is
+        quarantined, or a CPU-served apply failed) — recoverable via
+        :meth:`replay_dead_letters`; the undo replica is only fed
+        accepted updates so it cannot diverge from the room."""
+        accepted = self.engine.queue_update(self.doc_id(guid), update, v2=v2)
         self._m_updates_rx.inc()
         self._m_ingress_bytes.inc(len(update))
+        if not accepted:
+            return False
         self._dirty = True
         ru = self._undo.get(guid)
         if ru is not None:
             ru.apply_update(update, tracked=undoable, v2=v2)
+        return True
 
     # -- server-side undo ---------------------------------------------------
 
@@ -260,8 +270,16 @@ class TpuProvider:
         stay served by the CPU core so no data is lost, but the operator
         is alerted on every flush until they act."""
         if self._dirty:
-            self.engine.flush()
+            # reset BEFORE the engine call and restore only if it fails:
+            # raising after the engine integrated (as the device-policy
+            # check below does) must not leave the provider re-flushing
+            # already-integrated work forever
             self._dirty = False
+            try:
+                self.engine.flush()
+            except Exception:
+                self._dirty = True  # flush incomplete: retry next call
+                raise
         if self.backend == "device" and self.engine.fallback:
             d = self.engine.demotions[0]
             raise RuntimeError(
@@ -288,17 +306,33 @@ class TpuProvider:
         diff reflects everything received so far.
         """
         dec = Decoder(message)
-        msg_type = decoding.read_var_uint(dec)
         doc = self.doc_id(guid)
+        try:
+            msg_type = decoding.read_var_uint(dec)
+        except Exception as e:
+            self._m_sync_msgs.labels(type="bad").inc()
+            self.engine._dead_letter(
+                doc, message, False, f"bad-frame: {type(e).__name__}: {e}"
+            )
+            return None
         if msg_type == protocol.MESSAGE_YJS_SYNC_STEP_1:
             self._m_sync_msgs.labels(type="step1").inc()
             self.flush()
-            remote_sv = decoding.read_var_uint8_array(dec)
+            try:
+                remote_sv = decoding.read_var_uint8_array(dec)
+                diff = self.engine.encode_state_as_update(doc, remote_sv)
+            except Exception as e:
+                # truncated frame or garbage state vector: dead-letter
+                # and stay silent — the peer re-requests on reconnect
+                self._m_sync_msgs.labels(type="bad").inc()
+                self.engine._dead_letter(
+                    doc, message, False,
+                    f"bad-frame: {type(e).__name__}: {e}",
+                )
+                return None
             enc = Encoder()
             encoding.write_var_uint(enc, protocol.MESSAGE_YJS_SYNC_STEP_2)
-            encoding.write_var_uint8_array(
-                enc, self.engine.encode_state_as_update(doc, remote_sv)
-            )
+            encoding.write_var_uint8_array(enc, diff)
             reply = enc.to_bytes()
             self._m_step2.inc()
             self._m_step2_bytes.inc(len(reply))
@@ -309,12 +343,34 @@ class TpuProvider:
                 if msg_type == protocol.MESSAGE_YJS_SYNC_STEP_2
                 else "update"
             ).inc()
-            u = decoding.read_var_uint8_array(dec)
+            try:
+                u = decoding.read_var_uint8_array(dec)
+                validate_update(u)
+            except Exception as e:
+                # truncated frame or undecodable payload: the transport
+                # handed us damage — keep the whole frame recoverable in
+                # the dead-letter queue and keep serving the room (the
+                # peer's next sync step repairs the gap).  Validating at
+                # the network seam keeps transport damage out of the
+                # engine entirely: no rollback, no demotion.
+                self._m_sync_msgs.labels(type="bad").inc()
+                self.engine._dead_letter(
+                    doc, message, False,
+                    f"bad-frame: {type(e).__name__}: {e}",
+                )
+                return None
             self._m_ingress_bytes.inc(len(u))
-            self.engine.queue_update(doc, u)
-            self._dirty = True
+            if self.engine.queue_update(doc, u):
+                self._dirty = True
             return None
-        raise ValueError(f"unknown sync message type {msg_type}")
+        # unknown frame type (newer protocol revision, or a corrupted
+        # type varint): count and skip — a hostile peer must not be able
+        # to crash the room by sending one unknown frame
+        self._m_sync_msgs.labels(type="unknown").inc()
+        self.engine._dead_letter(
+            doc, message, False, f"unknown-frame: type {msg_type}"
+        )
+        return None
 
     def handle_sync_step1_batch(
         self, messages: list[tuple[str, bytes]]
@@ -487,7 +543,7 @@ class TpuProvider:
         The key set is stable across every flush mode (apply / levels /
         seq / ``YTPU_NO_NATIVE_PLAN``) and is exactly
         ``yjs_tpu.obs.FLUSH_METRICS_SCHEMA``: counts ``n_docs_flushed``,
-        ``n_demoted``, ``n_fallback_docs``, ``n_rows_max``,
+        ``n_demoted``, ``n_rolled_back``, ``n_fallback_docs``, ``n_rows_max``,
         ``n_sched_entries``, ``n_levels``, ``level_width``,
         ``n_pending_docs``, ``pending_depth``, ``plan_threads``; the
         ``schedule_occupancy`` ratio; and the per-phase second timers
@@ -511,6 +567,55 @@ class TpuProvider:
         """JSON-able snapshot of the whole stack (see
         BatchEngine.metrics_snapshot)."""
         return self.engine.metrics_snapshot()
+
+    # -- resilience surface (ISSUE 2) ---------------------------------------
+
+    def health(self, guid: str | None = None) -> dict:
+        """Health of one room (``{"state", "consecutive_failures", ...}``;
+        rooms never seen failing report healthy), or — with no guid —
+        the fleet summary ``{"degraded", "quarantined", "tick"}``."""
+        h = self.engine.health
+        if guid is None:
+            return h.summary()
+        rec = h.record(self.doc_id(guid))
+        rec["guid"] = guid
+        return rec
+
+    def dead_letters(self, guid: str | None = None) -> list[dict]:
+        """Dead letters (oldest-first, JSON-able views), optionally for
+        one room.  Raw bytes stay in the engine's queue — replay them
+        with :meth:`replay_dead_letters`."""
+        doc = None if guid is None else self.doc_id(guid)
+        out = []
+        for e in self.engine.dead_letters.list(doc=doc):
+            d = e.as_dict()
+            d["guid"] = self._guid_of.get(e.doc)
+            out.append(d)
+        return out
+
+    def replay_dead_letters(
+        self, guid: str | None = None, seqs=None, repair=None,
+        readmit: bool = True,
+    ) -> dict:
+        """Re-inject dead letters (one room, or all) through the normal
+        ingestion path after a fix — see
+        :meth:`BatchEngine.replay_dead_letters`.  ``readmit`` defaults
+        to True here: an operator replaying a room's letters means "I
+        fixed it", which should override the quarantine backoff."""
+        doc = None if guid is None else self.doc_id(guid)
+        res = self.engine.replay_dead_letters(
+            doc=doc, seqs=seqs, repair=repair, readmit=readmit
+        )
+        if res["replayed"]:
+            self._dirty = True
+        return res
+
+    def resilience_snapshot(self) -> dict:
+        """JSON-able failure-isolation state with room guids attached."""
+        snap = self.engine.resilience_snapshot()
+        for rec in snap["docs"]:
+            rec["guid"] = self._guid_of.get(rec["doc"])
+        return snap
 
 
 class RoomUndoHandle:

@@ -1,0 +1,191 @@
+"""Deterministic fault injection at the provider/protocol seams.
+
+Models what a hostile transport can do to a CRDT deployment: corrupt,
+truncate, duplicate, reorder, and drop — applied to raw update payloads
+(``kind="update"``) or framed sync messages (``kind="frame"``).  All
+randomness comes from one seeded PRNG, so a chaos test failure replays
+byte-for-byte from its seed.
+
+Detectability contract: the injector only produces corruptions that are
+REJECTABLE — a corrupted update is verified (and if necessary forced) to
+fail :func:`yjs_tpu.updates.validate_update`, and a corrupted frame is
+rewritten so the tolerant frame reader rejects or skips it.  A bit flip
+that happens to decode as a *different valid update* is a Byzantine
+fault no CRDT convergence contract can absorb (garbage-in); real
+transports reject it by checksum, so the harness models the
+post-checksum world.  Faults applied are counted per kind in the
+process-global ``ytpu_chaos_faults_total{fault=...}`` family.
+
+Env knobs (all probabilities in [0, 1], default 0 = fault disabled):
+``YTPU_CHAOS_SEED`` (int, default 0), ``YTPU_CHAOS_CORRUPT``,
+``YTPU_CHAOS_TRUNCATE``, ``YTPU_CHAOS_DUP``, ``YTPU_CHAOS_REORDER``,
+``YTPU_CHAOS_DROP``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+from ..obs import global_registry
+from ..updates import InvalidUpdate, validate_update
+
+_FAULTS = ("corrupt", "truncate", "duplicate", "reorder", "drop")
+
+# 9 continuation bytes splice a ~2**63 count into the leading varint:
+# whatever follows, the decoder's struct loop exhausts the buffer and
+# raises — the guaranteed-invalid fallback when random flips fail
+_POISON_PREFIX = b"\xff" * 9
+
+
+def _env_float(env, name: str, default: float = 0.0) -> float:
+    try:
+        return min(1.0, max(0.0, float(env.get(name, default))))
+    except (TypeError, ValueError):
+        return default
+
+
+class ChaosConfig:
+    """Per-fault probabilities + PRNG seed."""
+
+    __slots__ = ("seed", "corrupt", "truncate", "duplicate", "reorder", "drop")
+
+    def __init__(
+        self,
+        seed: int = 0,
+        corrupt: float = 0.0,
+        truncate: float = 0.0,
+        duplicate: float = 0.0,
+        reorder: float = 0.0,
+        drop: float = 0.0,
+    ):
+        self.seed = seed
+        self.corrupt = corrupt
+        self.truncate = truncate
+        self.duplicate = duplicate
+        self.reorder = reorder
+        self.drop = drop
+
+    @classmethod
+    def from_env(cls, env=None) -> "ChaosConfig":
+        env = os.environ if env is None else env
+        try:
+            seed = int(env.get("YTPU_CHAOS_SEED", "0"))
+        except (TypeError, ValueError):
+            seed = 0
+        return cls(
+            seed=seed,
+            corrupt=_env_float(env, "YTPU_CHAOS_CORRUPT"),
+            truncate=_env_float(env, "YTPU_CHAOS_TRUNCATE"),
+            duplicate=_env_float(env, "YTPU_CHAOS_DUP"),
+            reorder=_env_float(env, "YTPU_CHAOS_REORDER"),
+            drop=_env_float(env, "YTPU_CHAOS_DROP"),
+        )
+
+    def any_faults(self) -> bool:
+        return any(
+            getattr(self, f) > 0.0
+            for f in ("corrupt", "truncate", "duplicate", "reorder", "drop")
+        )
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class ChaosInjector:
+    """Applies one :class:`ChaosConfig`'s fault mix to message streams.
+
+    ``kind="update"`` treats payloads as raw (V1) update bytes and holds
+    corruption to the detectability contract via ``validate_update``;
+    ``kind="frame"`` treats them as framed sync messages and corrupts
+    the framing itself (unknown message type / inflated length varint),
+    which the tolerant ``read_sync_message`` path skips and counts.
+    """
+
+    def __init__(self, config: ChaosConfig | None = None, kind: str = "update"):
+        if kind not in ("update", "frame"):
+            raise ValueError(f"unknown chaos kind {kind!r}")
+        self.config = config if config is not None else ChaosConfig.from_env()
+        self.kind = kind
+        self.rng = random.Random(self.config.seed)
+        self.fault_counts: dict[str, int] = {f: 0 for f in _FAULTS}
+        fam = global_registry().counter(
+            "ytpu_chaos_faults_total",
+            "Faults injected by the chaos harness, by fault kind",
+            labelnames=("fault",),
+        )
+        self._children = {f: fam.labels(fault=f) for f in _FAULTS}
+
+    def _hit(self, fault: str) -> None:
+        self.fault_counts[fault] += 1
+        self._children[fault].inc()
+
+    # -- fault primitives ---------------------------------------------------
+
+    def corrupt(self, payload: bytes) -> bytes:
+        """Flip bits until the payload is verifiably rejectable."""
+        self._hit("corrupt")
+        if self.kind == "frame":
+            # rewrite the leading message-type varint to an unknown type
+            # (or inflate it): both deterministically un-integratable
+            if self.rng.random() < 0.5:
+                return b"\x7f" + payload[1:]
+            return _POISON_PREFIX + payload
+        out = bytearray(payload)
+        for _ in range(8):
+            if not out:
+                break
+            i = self.rng.randrange(len(out))
+            out[i] ^= 1 << self.rng.randrange(8)
+            try:
+                validate_update(bytes(out))
+            except InvalidUpdate:
+                return bytes(out)
+        return _POISON_PREFIX + bytes(payload)
+
+    def truncate(self, payload: bytes) -> bytes:
+        """Cut the payload short (verified rejectable for updates)."""
+        self._hit("truncate")
+        if not payload:
+            return payload
+        for _ in range(8):
+            cut = self.rng.randrange(len(payload))
+            out = payload[:cut]
+            if self.kind == "frame":
+                return out
+            try:
+                validate_update(out)
+            except InvalidUpdate:
+                return out
+        return _POISON_PREFIX + payload
+
+    # -- stream application -------------------------------------------------
+
+    def apply(self, messages: list[bytes]) -> list[bytes]:
+        """One fault-mix pass over a message stream.
+
+        Per message: maybe drop, maybe duplicate, maybe corrupt or
+        truncate (each delivered copy faulted independently); then maybe
+        reorder the whole batch.  Deterministic in (config.seed, input).
+        """
+        cfg = self.config
+        rng = self.rng
+        out: list[bytes] = []
+        for m in messages:
+            if cfg.drop and rng.random() < cfg.drop:
+                self._hit("drop")
+                continue
+            copies = [m]
+            if cfg.duplicate and rng.random() < cfg.duplicate:
+                self._hit("duplicate")
+                copies.append(m)
+            for c in copies:
+                if cfg.corrupt and rng.random() < cfg.corrupt:
+                    c = self.corrupt(c)
+                elif cfg.truncate and rng.random() < cfg.truncate:
+                    c = self.truncate(c)
+                out.append(c)
+        if len(out) > 1 and cfg.reorder and rng.random() < cfg.reorder:
+            self._hit("reorder")
+            rng.shuffle(out)
+        return out

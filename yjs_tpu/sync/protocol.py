@@ -25,6 +25,10 @@ MESSAGE_YJS_SYNC_STEP_1 = 0
 MESSAGE_YJS_SYNC_STEP_2 = 1
 MESSAGE_YJS_UPDATE = 2
 
+# skip-and-count marker returned by read_sync_message for frames it
+# tolerated but could not dispatch (unknown type / malformed payload)
+MESSAGE_UNKNOWN = -1
+
 _TYPE_NAMES = {
     MESSAGE_YJS_SYNC_STEP_1: "step1",
     MESSAGE_YJS_SYNC_STEP_2: "step2",
@@ -38,7 +42,10 @@ _frames = global_registry().get("ytpu_sync_messages_total")
 
 def _count(direction: str, message_type: int) -> None:
     if _frames is not None:
-        _frames.labels(dir=direction, type=_TYPE_NAMES[message_type]).inc()
+        # unknown types count under "unknown" instead of KeyError'ing —
+        # a hostile peer must never be able to crash the frame counter
+        name = _TYPE_NAMES.get(message_type, "unknown")
+        _frames.labels(dir=direction, type=name).inc()
 
 
 def write_sync_step1(encoder: Encoder, doc: Doc) -> None:
@@ -77,6 +84,15 @@ def read_update_message(decoder: Decoder, doc: Doc, transaction_origin=None) -> 
 
 
 def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_origin=None) -> int:
+    """Dispatch one sync frame; returns its message type.
+
+    Tolerant by contract (y-protocols sync.js readSyncMessage logs and
+    continues): a frame whose type is unknown — a newer protocol
+    revision, or transport corruption of the type varint — is counted
+    as ``ytpu_sync_messages_total{type="unknown"}`` and skipped, and
+    :data:`MESSAGE_UNKNOWN` is returned so callers can surface it.  A
+    truncated/garbage type varint raises ``ValueError`` as before (there
+    is no frame to skip past)."""
     message_type = decoding.read_var_uint(decoder)
     if message_type == MESSAGE_YJS_SYNC_STEP_1:
         read_sync_step1(decoder, encoder, doc)
@@ -85,5 +101,6 @@ def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_
     elif message_type == MESSAGE_YJS_UPDATE:
         read_update_message(decoder, doc, transaction_origin)
     else:
-        raise ValueError(f"unknown sync message type {message_type}")
+        _count("read", message_type)
+        return MESSAGE_UNKNOWN
     return message_type

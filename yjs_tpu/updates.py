@@ -138,29 +138,55 @@ def read_clients_struct_refs(decoder, client_refs: dict, doc: Doc) -> dict:
     return client_refs
 
 
-def _resume_struct_integration(transaction: Transaction, store: StructStore) -> None:
-    """Iterative dependency-stack integrator; pauses when a causal dep is
-    missing (reference encoding.js:225-321)."""
+def _resume_struct_integration(transaction: Transaction, store: StructStore) -> bool:
+    """Iterative dependency-stack integrator (reference
+    encoding.js:225-321).  A chain stalled on a missing causal dep is
+    PARKED — the chained structs go back into their clients' pending
+    refs and those clients retire from this pass — while integration
+    continues for every other client (the reference's restStructs /
+    addStackToRestSS mechanism).  Without parking, one permanently-lost
+    struct (e.g. dropped on every replica) would block unrelated
+    clients' structs forever and replicas could never reconverge.
+
+    Returns True if at least one struct integrated (callers loop to a
+    fixpoint so cross-client cascades drain in one apply)."""
     stack = store.pending_stack
     clients_struct_refs = store.pending_clients_struct_refs
     client_ids = sorted(clients_struct_refs.keys())
-    if not client_ids:
-        return
+    if not client_ids and not stack:
+        return False
+    parked: set[int] = set()
+    progressed = False
+
+    def park_stalled(chain):
+        for item in chain:
+            c = item.id.client
+            refs = clients_struct_refs.get(c)
+            if refs is None:
+                refs = clients_struct_refs[c] = {"refs": [], "i": 0}
+            rest = refs["refs"][refs["i"]:]
+            rest.append(item)
+            rest.sort(key=lambda s: s.id.clock)
+            refs["refs"] = rest
+            refs["i"] = 0
+            parked.add(c)
+        client_ids[:] = [c for c in client_ids if c not in parked]
+        stack.clear()
 
     def get_next_structs_target():
+        if not client_ids:
+            return None
         target = clients_struct_refs[client_ids[-1]]
         while len(target["refs"]) == target["i"]:
             client_ids.pop()
-            if client_ids:
-                target = clients_struct_refs[client_ids[-1]]
-            else:
-                store.pending_clients_struct_refs.clear()
+            if not client_ids:
                 return None
+            target = clients_struct_refs[client_ids[-1]]
         return target
 
     cur_structs_target = get_next_structs_target()
     if cur_structs_target is None and not stack:
-        return
+        return False
 
     if stack:
         stack_head = stack.pop()
@@ -191,14 +217,20 @@ def _resume_struct_integration(transaction: Transaction, store: StructStore) -> 
                     struct_refs["refs"] = remaining
                     struct_refs["i"] = 0
                     continue
-            # wait until the missing struct arrives
-            stack.append(stack_head)
-            return
+            # the gap-filler hasn't arrived: park this chain, keep going
+            park_stalled(stack + [stack_head])
+            cur_structs_target = get_next_structs_target()
+            if cur_structs_target is None:
+                break
+            stack_head = cur_structs_target["refs"][cur_structs_target["i"]]
+            cur_structs_target["i"] += 1
+            continue
         missing = stack_head.get_missing(transaction, store)
         if missing is None:
             if offset == 0 or offset < stack_head.length:
                 stack_head.integrate(transaction, offset)
                 state_cache[client] = stack_head.id.clock + stack_head.length
+                progressed = True
             if stack:
                 stack_head = stack.pop()
             elif (
@@ -216,13 +248,22 @@ def _resume_struct_integration(transaction: Transaction, store: StructStore) -> 
         else:
             struct_refs = clients_struct_refs.get(missing) or {"refs": [], "i": 0}
             if len(struct_refs["refs"]) == struct_refs["i"]:
-                # this update causally depends on a not-yet-received update
-                stack.append(stack_head)
-                return
+                # causally depends on a not-yet-received update: park
+                park_stalled(stack + [stack_head])
+                cur_structs_target = get_next_structs_target()
+                if cur_structs_target is None:
+                    break
+                stack_head = cur_structs_target["refs"][cur_structs_target["i"]]
+                cur_structs_target["i"] += 1
+                continue
             stack.append(stack_head)
             stack_head = struct_refs["refs"][struct_refs["i"]]
             struct_refs["i"] += 1
-    store.pending_clients_struct_refs.clear()
+    # everything not parked either integrated or was fully consumed
+    for c in list(clients_struct_refs):
+        if c not in parked:
+            del clients_struct_refs[c]
+    return progressed
 
 
 def try_resume_pending_delete_readers(transaction: Transaction, store: StructStore) -> None:
@@ -263,8 +304,13 @@ def read_structs(decoder, transaction: Transaction, store: StructStore) -> None:
     clients_struct_refs: dict = {}
     read_clients_struct_refs(decoder, clients_struct_refs, transaction.doc)
     _merge_read_structs_into_pending_reads(store, clients_struct_refs)
-    _resume_struct_integration(transaction, store)
-    _cleanup_pending_structs(store.pending_clients_struct_refs)
+    # fixpoint: each pass may integrate structs that unblock a client
+    # parked in an earlier pass (the reference achieves the same by
+    # recursively re-applying store.pendingStructs on progress)
+    progressed = True
+    while progressed and store.pending_clients_struct_refs:
+        progressed = _resume_struct_integration(transaction, store)
+        _cleanup_pending_structs(store.pending_clients_struct_refs)
     try_resume_pending_delete_readers(transaction, store)
 
 
@@ -357,6 +403,52 @@ def encode_state_vector_v2(doc: Doc, encoder=None) -> bytes:
 
 def encode_state_vector(doc: Doc) -> bytes:
     return encode_state_vector_v2(doc, default_ds_encoder())
+
+
+# ---------------------------------------------------------------------------
+# Validating decoder entry point (resilience seam)
+# ---------------------------------------------------------------------------
+
+class InvalidUpdate(ValueError):
+    """Raised by :func:`validate_update` for bytes that cannot be decoded
+    as a complete V1/V2 update (truncation, bit corruption, varint
+    overflow, garbage framing).
+
+    Distinct from :class:`yjs_tpu.ops.columns.UnsupportedUpdate`: that
+    marks WELL-FORMED traffic outside the device path's scope (demote to
+    the CPU core); this marks bytes no path can apply (quarantine +
+    dead-letter, never integrate)."""
+
+
+def validate_update(update: bytes, v2: bool = False) -> dict:
+    """Structurally decode ``update`` without applying it anywhere.
+
+    The single validation seam the resilience layer (quarantine,
+    dead-letter triage, chaos suite) trusts: it walks the full struct
+    section and the trailing DeleteSet exactly like integration would,
+    so bytes that pass here decode on both the CPU core and the mirror
+    planner.  Returns a summary ``{"clients", "structs", "ds_ranges",
+    "bytes"}``; raises :class:`InvalidUpdate` on malformed input.
+    """
+    if not isinstance(update, (bytes, bytearray, memoryview)):
+        raise InvalidUpdate(f"not a bytes payload: {type(update).__name__}")
+    update = bytes(update)
+    if not update:
+        raise InvalidUpdate("empty update")
+    # the doc-free ref scanner is the same decoder the flush planner runs
+    # (native columnar scan with pure-Python arbitration on failure)
+    from .ops.columns import decode_update_refs
+
+    try:
+        refs, ds = decode_update_refs(update, v2)
+    except Exception as e:
+        raise InvalidUpdate(f"{type(e).__name__}: {e}") from e
+    return {
+        "clients": len(refs),
+        "structs": sum(len(rs) for rs in refs.values()),
+        "ds_ranges": len(ds),
+        "bytes": len(update),
+    }
 
 
 # ---------------------------------------------------------------------------
