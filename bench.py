@@ -650,6 +650,62 @@ def bench_resilience(n_ops: int = 200) -> dict:
     }
 
 
+def bench_durability(n_ops: int = 200) -> dict:
+    """WAL flush-path overhead: the same per-doc ingest+flush with the
+    journal off, on with ``fsync=never`` (journaling cost alone: encode
+    + CRC + buffered write), and on with ``fsync=always`` (worst-case
+    durable mode — one disk round trip per update)."""
+    import gc
+    import shutil
+    import tempfile
+
+    from yjs_tpu.persistence import WalConfig
+    from yjs_tpu.provider import TpuProvider
+
+    n_docs = int(os.environ.get("YTPU_BENCH_WAL_DOCS", "64"))
+    updates = load_distinct_traces(n_docs, n_ops)
+
+    def run(fsync: str | None, runs: int = 3) -> float:
+        times = []
+        for _ in range(runs):
+            gc.collect()
+            wal_dir = tempfile.mkdtemp(prefix="ytpu-bench-wal-")
+            try:
+                prov = TpuProvider(
+                    n_docs,
+                    wal_dir=wal_dir if fsync else None,
+                    wal_config=WalConfig(fsync=fsync) if fsync else None,
+                )
+                t0 = time.perf_counter()
+                for i, u in enumerate(updates):
+                    prov.receive_update(f"room-{i}", u)
+                prov.flush()
+                np.asarray(prov.engine._right[:, 0])
+                times.append(time.perf_counter() - t0)
+                prov = None
+            finally:
+                shutil.rmtree(wal_dir, ignore_errors=True)
+        times.sort()
+        return times[len(times) // 2]
+
+    t_off = run(None)  # also warms the compile cache
+    t_never = run("never")
+    t_always = run("always")
+    return {
+        "n_docs": n_docs,
+        "trace_ops": n_ops,
+        "wal_off_s": round(t_off, 4),
+        "wal_never_s": round(t_never, 4),
+        "wal_always_s": round(t_always, 4),
+        "journal_overhead_pct": (
+            round(100 * (t_never - t_off) / t_off, 1) if t_off else 0
+        ),
+        "fsync_overhead_pct": (
+            round(100 * (t_always - t_off) / t_off, 1) if t_off else 0
+        ),
+    }
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -699,6 +755,8 @@ def main():
     b4 = bench_b4_broadcast(n_docs_b4)
     time.sleep(3)
     resilience = bench_resilience()
+    time.sleep(3)
+    durability = bench_durability()
     sweep = (
         sweep_distinct(n_ops)
         if os.environ.get("YTPU_BENCH_SWEEP")
@@ -750,6 +808,7 @@ def main():
             ),
             "obs": obs_summary,
             "resilience": resilience,
+            "durability": durability,
         },
     }
     if sweep is not None:

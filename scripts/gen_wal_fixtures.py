@@ -1,0 +1,194 @@
+"""Generate the damaged-WAL fixture set for the durability suite.
+
+Builds small real WAL directories by driving a :class:`TpuProvider`
+with deterministic multi-room traffic (tiny segment size so rotation
+actually happens), then damages copies of them the ways crashes and
+disks actually do: torn tails on the final segment, single-bit flips in
+sealed segments and checkpoint files, and mid-log truncations.  Each
+case directory is a complete WAL a test can hand to
+``TpuProvider.recover`` (on a tmp COPY — recovery truncates torn
+tails in place).
+
+The manifest records, per case, the GOLDEN recovery outcome computed at
+generation time by actually recovering a scratch copy: the per-room
+texts plus the key ``last_recovery`` stats.  A clean case is verified
+byte-equal to the oracle texts before anything is written.
+
+Writes, under tests/fixtures/wal/:
+
+- ``manifest.json`` — schema version, generator seed, one record per
+  case: directory, damage kind, notes, expected texts + recovery stats;
+- ``<case>/`` — one WAL directory per case (segments + checkpoints).
+
+Usage: python scripts/gen_wal_fixtures.py [seed]
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import yjs_tpu as Y
+from yjs_tpu.persistence import WalConfig, list_checkpoints, list_segments
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.resilience import DiskFaultInjector
+
+SCHEMA_VERSION = 1
+OUT_DIR = Path(__file__).resolve().parent.parent / "tests" / "fixtures" / "wal"
+ROOMS = ("alpha", "beta")
+
+
+def room_updates(seed: int, n_ops: int = 50) -> list[bytes]:
+    """Per-op incremental updates from three editing clients."""
+    gen = random.Random(seed)
+    docs, updates = [], []
+    for k in range(3):
+        d = Y.Doc(gc=False)
+        d.client_id = 1000 * (seed + 1) + k
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        docs.append(d)
+    for _ in range(n_ops):
+        d = gen.choice(docs)
+        t = d.get_text("text")
+        if len(t) and gen.random() < 0.3:
+            t.delete(gen.randrange(len(t)), 1)
+        else:
+            t.insert(gen.randrange(len(t) + 1), gen.choice("abcdef "))
+    return updates
+
+
+def build_wal(path: Path, seed: int, checkpoint_mid: bool) -> dict[str, str]:
+    """Drive a provider into ``path``; returns the oracle texts."""
+    prov = TpuProvider(
+        len(ROOMS),
+        backend="cpu",
+        wal_dir=path,
+        wal_config=WalConfig(segment_bytes=400, fsync="never"),
+    )
+    streams = {g: room_updates(seed + j) for j, g in enumerate(ROOMS)}
+    half = {g: len(us) // 2 for g, us in streams.items()}
+    for g, us in streams.items():
+        for u in us[: half[g]]:
+            prov.receive_update(g, u)
+    if checkpoint_mid:
+        prov.checkpoint()
+    for g, us in streams.items():
+        for u in us[half[g] :]:
+            prov.receive_update(g, u)
+    prov.flush()
+    texts = {g: prov.text(g) for g in ROOMS}
+    # a crashed predecessor never seals: leave the dir torn-write-ready
+    prov.wal.abandon()
+    return texts
+
+
+def golden_recovery(case_dir: Path) -> dict:
+    """Recover a scratch copy; return the observed texts + stats."""
+    scratch = Path(tempfile.mkdtemp(prefix="walfix-"))
+    shutil.rmtree(scratch)
+    shutil.copytree(case_dir, scratch)
+    try:
+        prov = TpuProvider.recover(scratch, backend="cpu")
+        lr = prov.last_recovery
+        return {
+            "texts": {g: prov.text(g) for g in sorted(prov._guids)},
+            "outcome": lr["outcome"],
+            "torn_truncations": lr["torn_truncations"],
+            "corrupt_records": lr["corrupt_records"],
+            "dead_lettered": lr["dead_lettered"],
+        }
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def main(seed: int = 23) -> None:
+    if OUT_DIR.exists():
+        shutil.rmtree(OUT_DIR)
+    OUT_DIR.mkdir(parents=True)
+
+    cases = []
+
+    def emit(name: str, kind: str, note: str, build_seed: int,
+             checkpoint_mid: bool, damage=None) -> None:
+        case_dir = OUT_DIR / name
+        oracle = build_wal(case_dir, build_seed, checkpoint_mid)
+        if damage is not None:
+            note = f"{note}; {damage(case_dir)}"
+        golden = golden_recovery(case_dir)
+        if kind == "clean" and golden["texts"] != oracle:
+            raise SystemExit(
+                f"case {name}: clean recovery diverged from the oracle"
+            )
+        cases.append({
+            "dir": name,
+            "kind": kind,
+            "note": note,
+            "expected": golden,
+        })
+
+    inj = DiskFaultInjector(seed=seed)
+
+    emit("clean", "clean", "undamaged log, no checkpoint", seed, False)
+    emit("ckpt_clean", "clean", "undamaged log with a mid-stream "
+         "checkpoint (snapshot-then-tail)", seed + 10, True)
+
+    def tear_final(d: Path) -> str:
+        _i, p = list_segments(d)[-1]
+        cut = inj.tear(p, max_bytes=96)
+        return f"tore {cut} bytes off {p.name}"
+
+    emit("torn_tail_00", "torn_tail", "torn write on the final segment",
+         seed + 20, False, tear_final)
+    emit("torn_tail_01", "torn_tail", "torn write on the final segment, "
+         "checkpointed history", seed + 30, True, tear_final)
+
+    def flip_sealed(d: Path) -> str:
+        _i, p = list_segments(d)[0]
+        off = inj.bitflip(p, lo=8)
+        return f"flipped a bit at offset {off} of {p.name}"
+
+    emit("bitflip_00", "bitflip", "one bit flipped in a sealed segment",
+         seed + 40, False, flip_sealed)
+
+    def flip_ckpt(d: Path) -> str:
+        _u, p = list_checkpoints(d)[-1]
+        off = inj.bitflip(p, lo=8)
+        return f"flipped a bit at offset {off} of {p.name}"
+
+    emit("ckpt_snapcorrupt_00", "bitflip", "one bit flipped in the "
+         "checkpoint file's snapshot records", seed + 50, True, flip_ckpt)
+
+    def midtrunc(d: Path) -> str:
+        _i, p = list_segments(d)[0]
+        size = p.stat().st_size
+        keep = max(9, size // 2)
+        p.write_bytes(p.read_bytes()[:keep])
+        return f"truncated {p.name} from {size} to {keep} bytes"
+
+    emit("midtrunc_00", "midtrunc", "sealed segment cut in half "
+         "(unparseable tail, resync on the next file)", seed + 60,
+         False, midtrunc)
+
+    # damage landed for every damaged case (deterministic given seed)
+    damaged = [c for c in cases if c["kind"] != "clean"]
+    if any(c["expected"]["outcome"] == "clean" for c in damaged):
+        raise SystemExit("a damaged case recovered 'clean' — damage missed")
+
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "seed": seed,
+        "rooms": list(ROOMS),
+        "cases": cases,
+    }
+    (OUT_DIR / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    print(f"wrote {len(cases)} WAL cases to {OUT_DIR}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 23)

@@ -48,6 +48,13 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection convergence tests",
     )
+    # "durability" tags the WAL/recovery suite (ISSUE 3) — in tier-1 by
+    # default (tmp-dir local, deterministic), deselectable with
+    # -m 'not durability'
+    config.addinivalue_line(
+        "markers",
+        "durability: write-ahead-log persistence and crash-recovery tests",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,147 @@
+"""Crash-point chaos harness (ISSUE 3 acceptance): kill a journaling
+provider at randomized points under the full transport-fault mix, tear
+and bit-flip its WAL files, recover, and require byte-identical
+reconvergence with an uninterrupted reference.
+
+Both providers consume the SAME faulted stream (one injector pass), so
+any divergence is recovery's fault, not the transport's.  The crash is
+``WriteAheadLog.abandon()`` — the file handle is dropped with no
+seal-time fsync, leaving the directory exactly as a killed process
+would.  Mid-log at-rest damage (a flipped bit in a sealed segment) must
+land in the dead-letter queue, never abort the replay.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.lib0 import encoding
+from yjs_tpu.lib0.encoding import Encoder
+from yjs_tpu.persistence import WalConfig, list_segments
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.resilience import ChaosConfig, ChaosInjector, DiskFaultInjector
+from yjs_tpu.sync import protocol
+
+pytestmark = [pytest.mark.chaos, pytest.mark.durability]
+
+ROOM = "room"
+BACKENDS = ("cpu", "auto")
+# the test_chaos.py "everything" mix: every fault class at once
+EVERYTHING = dict(
+    corrupt=0.15, truncate=0.1, duplicate=0.25, reorder=0.6, drop=0.15
+)
+
+
+def client_updates(seed: int, n_ops: int = 50, n_clients: int = 3):
+    """Per-op incremental updates from independent editing clients
+    (same traffic texture as tests/test_chaos.py)."""
+    gen = random.Random(seed)
+    docs = []
+    updates: list[bytes] = []
+    for k in range(n_clients):
+        d = Y.Doc(gc=False)
+        d.client_id = 1000 + k
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        docs.append(d)
+    for _ in range(n_ops):
+        d = gen.choice(docs)
+        t = d.get_text("text")
+        if len(t) and gen.random() < 0.3:
+            t.delete(gen.randrange(len(t)), 1)
+        else:
+            t.insert(gen.randrange(len(t) + 1), gen.choice("abcdef "))
+    return updates
+
+
+def frame(update: bytes) -> bytes:
+    enc = Encoder()
+    encoding.write_var_uint(enc, protocol.MESSAGE_YJS_UPDATE)
+    encoding.write_var_uint8_array(enc, update)
+    return enc.to_bytes()
+
+
+def sync_repair(pa: TpuProvider, pb: TpuProvider, rounds: int = 5) -> None:
+    """Clean bidirectional step1/step2 exchange (post-chaos heal)."""
+    for _ in range(rounds):
+        reply = pb.handle_sync_message(ROOM, pa.sync_step1(ROOM))
+        if reply is not None:
+            pa.handle_sync_message(ROOM, reply)
+        reply = pa.handle_sync_message(ROOM, pb.sync_step1(ROOM))
+        if reply is not None:
+            pb.handle_sync_message(ROOM, reply)
+
+
+def canonical(prov: TpuProvider) -> bytes:
+    """merge_updates-normalized full state: equal stores yield
+    IDENTICAL bytes regardless of split/arrival history."""
+    return Y.merge_updates([prov.encode_state_as_update(ROOM)])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("crash_seed", range(10))
+def test_crash_recover_reconverges_bytewise(backend, crash_seed, tmp_path):
+    updates = client_updates(seed=400 + crash_seed)
+    frames = [frame(u) for u in updates]
+    # ONE injector pass feeds BOTH replicas: identical faulted stream
+    inj = ChaosInjector(
+        ChaosConfig(seed=crash_seed, **EVERYTHING), kind="frame"
+    )
+    faulted = inj.apply(frames)
+    assert sum(inj.fault_counts.values()) > 0
+
+    ref = TpuProvider(2, backend=backend)
+    victim = TpuProvider(
+        2,
+        backend=backend,
+        wal_dir=tmp_path,
+        wal_config=WalConfig(segment_bytes=256, fsync="never"),
+    )
+    for f in faulted:
+        ref.handle_sync_message(ROOM, f)
+
+    crash_rng = random.Random(9000 + crash_seed)
+    c = crash_rng.randrange(1, len(faulted))
+    for k, f in enumerate(faulted[:c]):
+        victim.handle_sync_message(ROOM, f)
+        if k == c // 2 and k > 0:
+            victim.checkpoint()  # compaction mid-life, like production
+    victim.wal.abandon()  # kill -9
+
+    # disk damage on what the dead process left behind
+    disk = DiskFaultInjector(seed=7000 + crash_seed)
+    segs = list_segments(tmp_path)
+    flipped = False
+    if segs:
+        disk.tear(segs[-1][1])  # torn tail on the active segment
+        if len(segs) > 1:
+            flipped = disk.bitflip(segs[0][1], lo=8) >= 0
+
+    victim = TpuProvider.recover(
+        tmp_path,
+        n_docs=2,
+        backend=backend,
+        wal_config=WalConfig(segment_bytes=256, fsync="never"),
+    )
+    if flipped:
+        assert victim.last_recovery["corrupt_records"] >= 1
+        assert any(
+            d["reason"].startswith("wal-corrupt")
+            for d in victim.dead_letters()
+        )
+
+    # the rest of the stream arrives at the recovered victim
+    for f in faulted[c:]:
+        victim.handle_sync_message(ROOM, f)
+
+    # heal: quarantine backoff cleared (operator readmission, as in
+    # test_chaos), then clean sync rounds
+    ref.engine.health.reset(None)
+    victim.engine.health.reset(None)
+    sync_repair(ref, victim)
+
+    assert victim.text(ROOM) == ref.text(ROOM)
+    assert victim.state_vector(ROOM) == ref.state_vector(ROOM)
+    assert canonical(victim) == canonical(ref)

@@ -765,6 +765,31 @@ class BatchEngine:
         self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
         self._starts = self._starts.at[idx].set(self._put_r(new_starts))
 
+    # -- doc eviction -------------------------------------------------------
+
+    def reset_doc(self, doc: int) -> None:
+        """Return one slot to its just-constructed state (provider
+        release_doc, ISSUE 3): fresh mirror, empty update log, cleared
+        health record, and the device rows blanked to the same fills a
+        new engine allocates — the slot's next tenant starts from
+        nothing.  The dead-letter queue is NOT touched here (the caller
+        decides whether the slot's letters travel with the evicted
+        doc)."""
+        self.mirrors[doc] = make_mirror(self.root_name)
+        self.fallback.pop(doc, None)
+        self._update_log[doc] = []
+        self._uploaded_rows[doc] = 0
+        self._rows_at_compact[doc] = 0
+        self._event_listeners.pop(doc, None)
+        self.health.reset(doc)
+        if self._right is not None:
+            # blank the slot's device rows in place (same fills as the
+            # initial allocation); statics re-upload from row 0 is
+            # already forced by _uploaded_rows above
+            self._right = self._right.at[doc].set(NULL)
+            self._deleted = self._deleted.at[doc].set(False)
+            self._starts = self._starts.at[doc].set(NULL)
+
     # -- flush: run one device integration step ----------------------------
 
     def _phase_ctx(self, name: str, **args):
@@ -2193,6 +2218,15 @@ class BatchEngine:
                     needed[r], offset[r], v2=v2
                 )
         return replies
+
+    def encode_states_batched(
+        self, docs: list[int], v2: bool = False
+    ) -> list[bytes]:
+        """Full-state exports for many docs in ONE batched dispatch (a
+        sync-step-2 answer against the empty state vector) — the WAL
+        checkpoint's snapshot producer (ISSUE 3): compacting a fleet
+        must not cost one device round trip per doc."""
+        return self.sync_step2_batch([(i, None) for i in docs], v2=v2)
 
     def has_pending(self, doc: int) -> bool:
         if doc in self.fallback:

@@ -13,12 +13,30 @@ step 1 (state vector) / step 2 (diff update) / incremental updates.
 
 from __future__ import annotations
 
+import os
+
 from .lib0.decoding import Decoder
 from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
 from .ops.engine import BatchEngine
+from .persistence import (
+    KIND_RELEASE,
+    KIND_UPDATE,
+    WalConfig,
+    WalMetrics,
+    WriteAheadLog,
+)
 from .sync import protocol
 from .updates import validate_update
+
+
+class ProviderFullError(ValueError):
+    """Raised when every engine slot is taken and a new guid arrives.
+
+    Subclasses ``ValueError`` so pre-ISSUE-3 callers catching the old
+    bare ``ValueError("provider is full")`` keep working; new callers
+    can catch the typed error and :meth:`TpuProvider.release_doc` a
+    cold room to free a slot."""
 
 
 class TpuProvider:
@@ -35,6 +53,13 @@ class TpuProvider:
     - ``"device"``: device path with demotion FORBIDDEN — out-of-scope
       traffic raises instead, for deployments that must not absorb CPU
       work silently.
+
+    Durability (ISSUE 3): pass ``wal_dir`` (or set ``YTPU_WAL_DIR``) to
+    journal every accepted update to a checksummed write-ahead log
+    before it reaches the engine; :meth:`checkpoint` compacts the log
+    into per-doc snapshots, and :meth:`recover` rebuilds a provider
+    from a crashed predecessor's directory.  See
+    :mod:`yjs_tpu.persistence` and README "Durability".
     """
 
     def __init__(
@@ -44,6 +69,8 @@ class TpuProvider:
         mesh=None,
         gc: bool = False,
         backend: str = "auto",
+        wal_dir=None,
+        wal_config: WalConfig | None = None,
     ):
         self.backend = backend
         self.engine = BatchEngine(
@@ -100,17 +127,45 @@ class TpuProvider:
             "Observe-bridge events delivered to callbacks (post path "
             "filter)",
         )
+        self._m_evicted = r.counter(
+            "ytpu_provider_docs_evicted_total",
+            "Docs released from their engine slot (release_doc + "
+            "recovered release records)",
+        )
+        # slots freed by release_doc, reused before _next advances
+        self._free: list[int] = []
+        # WAL metric families register unconditionally (exposition and
+        # the schema checker must see them WAL or no WAL); the journal
+        # itself attaches only when a directory is configured
+        self._wal_metrics = WalMetrics(r)
+        if wal_dir is None:
+            wal_dir = os.environ.get("YTPU_WAL_DIR")
+        self.wal: WriteAheadLog | None = (
+            WriteAheadLog(wal_dir, wal_config, self._wal_metrics)
+            if wal_dir
+            else None
+        )
+        # stats dict of the replay that built this provider (recover())
+        self.last_recovery: dict | None = None
 
     # -- doc management -----------------------------------------------------
 
     def doc_id(self, guid: str) -> int:
-        """The engine slot for a doc guid (allocating on first use)."""
+        """The engine slot for a doc guid (allocating on first use;
+        slots freed by :meth:`release_doc` are reused first)."""
         i = self._guids.get(guid)
         if i is None:
-            if self._next >= self.engine.n_docs:
-                raise ValueError("provider is full")
-            i = self._next
-            self._next += 1
+            if self._free:
+                i = self._free.pop()
+            elif self._next < self.engine.n_docs:
+                i = self._next
+                self._next += 1
+            else:
+                raise ProviderFullError(
+                    f"provider is full ({self.engine.n_docs} docs); "
+                    "release_doc() a cold room to admit "
+                    f"{guid!r}"
+                )
             self._guids[guid] = i
             self._guid_of[i] = guid
         return i
@@ -169,7 +224,13 @@ class TpuProvider:
         quarantined, or a CPU-served apply failed) — recoverable via
         :meth:`replay_dead_letters`; the undo replica is only fed
         accepted updates so it cannot diverge from the room."""
-        accepted = self.engine.queue_update(self.doc_id(guid), update, v2=v2)
+        doc = self.doc_id(guid)
+        if self.wal is not None:
+            # journal BEFORE integrating (write-ahead): a crash between
+            # append and flush replays the update; the reverse order
+            # could integrate state the log never saw
+            self.wal.append(KIND_UPDATE, guid, update, v2=v2)
+        accepted = self.engine.queue_update(doc, update, v2=v2)
         self._m_updates_rx.inc()
         self._m_ingress_bytes.inc(len(update))
         if not accepted:
@@ -247,7 +308,12 @@ class TpuProvider:
         u = ru.undo()
         if u is not None:
             self._m_undo.labels(op="undo").inc()
-            self.engine.queue_update(self.doc_id(guid), u)
+            doc = self.doc_id(guid)
+            if self.wal is not None:
+                # the reverting bytes are room traffic like any other:
+                # recovery must replay the undo, not resurrect the text
+                self.wal.append(KIND_UPDATE, guid, u)
+            self.engine.queue_update(doc, u)
             self._dirty = True
             self.flush()
         return u
@@ -257,7 +323,10 @@ class TpuProvider:
         u = ru.redo()
         if u is not None:
             self._m_undo.labels(op="redo").inc()
-            self.engine.queue_update(self.doc_id(guid), u)
+            doc = self.doc_id(guid)
+            if self.wal is not None:
+                self.wal.append(KIND_UPDATE, guid, u)
+            self.engine.queue_update(doc, u)
             self._dirty = True
             self.flush()
         return u
@@ -360,6 +429,10 @@ class TpuProvider:
                 )
                 return None
             self._m_ingress_bytes.inc(len(u))
+            if self.wal is not None:
+                # journal the PAYLOAD, post-validation: transport damage
+                # (dead-lettered above) never enters the durable log
+                self.wal.append(KIND_UPDATE, guid, u)
             if self.engine.queue_update(doc, u):
                 self._dirty = True
             return None
@@ -530,7 +603,8 @@ class TpuProvider:
         """Every device→CPU demotion with its reason, keyed by room guid —
         scope gaps are measurable, not silent."""
         return [
-            {"guid": self._guid_of[d["doc"]], "reason": d["reason"]}
+            {"guid": self._guid_of.get(d["doc"], d["doc"]),
+             "reason": d["reason"]}
             for d in self.engine.demotions
         ]
 
@@ -603,6 +677,22 @@ class TpuProvider:
         to True here: an operator replaying a room's letters means "I
         fixed it", which should override the quarantine backoff."""
         doc = None if guid is None else self.doc_id(guid)
+        if self.wal is not None:
+            # replayed letters re-enter via engine.queue_update, below
+            # the provider's journal seam — wrap the repair hook so the
+            # bytes actually replayed are journaled like fresh traffic
+            inner = repair
+
+            def repair(e, _inner=inner):
+                fixed = _inner(e) if _inner is not None else e.update
+                if fixed is not None:
+                    g = self._guid_of.get(e.doc)
+                    if g is not None:
+                        self.wal.append(
+                            KIND_UPDATE, g, bytes(fixed), v2=e.v2
+                        )
+                return fixed
+
         res = self.engine.replay_dead_letters(
             doc=doc, seqs=seqs, repair=repair, readmit=readmit
         )
@@ -616,6 +706,136 @@ class TpuProvider:
         for rec in snap["docs"]:
             rec["guid"] = self._guid_of.get(rec["doc"])
         return snap
+
+    # -- durability surface (ISSUE 3) ---------------------------------------
+
+    def checkpoint(self) -> dict | None:
+        """Fold the WAL into per-doc snapshots + the DLQ dump and
+        truncate the journaled history (see
+        :meth:`yjs_tpu.persistence.WriteAheadLog.checkpoint`).  One
+        batched ``encode_states_batched`` dispatch snapshots the whole
+        fleet.  Returns the compaction stats (None without a WAL)."""
+        if self.wal is None:
+            return None
+        self.flush()
+        docs = sorted(self._guid_of)
+        snaps = self.engine.encode_states_batched(docs)
+        return self.wal.checkpoint(
+            [(self._guid_of[i], s) for i, s in zip(docs, snaps)],
+            self._dump_dlq(),
+        )
+
+    def close(self, checkpoint: bool = True) -> None:
+        """Orderly shutdown: flush, write a final checkpoint (so restart
+        recovery is one snapshot read, no tail replay), seal the WAL.
+        Safe without a WAL (just flushes)."""
+        self.flush()
+        if self.wal is not None:
+            if checkpoint:
+                self.checkpoint()
+            self.wal.close()
+
+    def release_doc(self, guid: str) -> bytes:
+        """Evict a room and free its engine slot for reuse (the typed
+        answer to :class:`ProviderFullError`).  The room's final state
+        is snapshotted, journaled as a release record (recovery then
+        knows the room left DELIBERATELY and must not resurrect it),
+        and returned — the caller archives it or hands it to another
+        provider.  The slot's dead letters are dropped with it: they
+        must not be misattributed to the slot's next tenant."""
+        i = self._guids.get(guid)
+        if i is None:
+            raise KeyError(f"unknown room {guid!r}")
+        self.flush()
+        final = self.engine.encode_state_as_update(i)
+        if self.wal is not None:
+            self.wal.append(KIND_RELEASE, guid, final)
+        self.engine.dead_letters.take(doc=i)
+        self.engine.reset_doc(i)
+        del self._guids[guid]
+        del self._guid_of[i]
+        self._undo.pop(guid, None)
+        self._undo_settings.pop(guid, None)
+        self._user_data = {
+            k: v for k, v in self._user_data.items() if k[0] != guid
+        }
+        self._free.append(i)
+        self._m_evicted.inc()
+        return final
+
+    def _apply_release_record(self, guid: str) -> None:
+        """Recovery saw a release record: forget the room (its snapshot
+        payload is the archived state, not live traffic)."""
+        i = self._guids.pop(guid, None)
+        if i is None:
+            return
+        self.engine.dead_letters.take(doc=i)
+        self.engine.reset_doc(i)
+        del self._guid_of[i]
+        self._free.append(i)
+        self._m_evicted.inc()
+
+    def _dump_dlq(self) -> dict:
+        """Checkpoint-grade DLQ dump with doc slots translated to guids
+        (slot numbers are not stable across a recovery)."""
+        state = self.engine.dead_letters.snapshot(letters=True)
+        for e in state.get("letters") or []:
+            e["guid"] = self._guid_of.get(e.pop("doc"))
+        return state
+
+    def _restore_dlq(self, state: dict) -> int:
+        """Re-enqueue a checkpoint's DLQ dump, mapping guids back to
+        this process's slots (letters for unknown/evicted rooms keep
+        doc=-1, same as other unattributable letters)."""
+        for e in state.get("letters") or []:
+            g = e.pop("guid", None)
+            if g is None:
+                e["doc"] = -1
+                continue
+            try:
+                e["doc"] = self.doc_id(g)
+            except ProviderFullError:
+                e["doc"] = -1
+        return self.engine.dead_letters.restore(state)
+
+    @classmethod
+    def recover(
+        cls,
+        path,
+        n_docs: int | None = None,
+        root_name: str = "text",
+        mesh=None,
+        gc: bool = False,
+        backend: str = "auto",
+        wal_config: WalConfig | None = None,
+    ) -> "TpuProvider":
+        """Rebuild a provider from a crashed predecessor's WAL directory.
+
+        Replays snapshot-then-tail (see
+        :func:`yjs_tpu.persistence.replay_wal`): torn final-segment
+        tails are truncated, mid-log corrupt records are dead-lettered,
+        and the rebuilt provider journals onward into the SAME
+        directory (its appends start a fresh segment past the replayed
+        history).  ``n_docs=None`` sizes the fleet from the distinct
+        guids in the log.  The replay stats land in
+        ``provider.last_recovery``."""
+        from .persistence import count_guids, replay_wal
+
+        if n_docs is None:
+            n_docs = max(1, count_guids(path))
+        prov = cls(
+            n_docs,
+            root_name=root_name,
+            mesh=mesh,
+            gc=gc,
+            backend=backend,
+            wal_dir=path,
+            wal_config=wal_config,
+        )
+        prov.last_recovery = replay_wal(
+            prov, path, exclude_from=prov.wal.first_index
+        )
+        return prov
 
 
 class RoomUndoHandle:

@@ -11,9 +11,12 @@ per-doc blast radius (ISSUE 2 tentpole):
   machine with exponential (flush-tick) backoff before re-admission;
 - :mod:`.deadletter` — bounded dead-letter queue keeping rejected update
   bytes with reason + timestamp, replayable after a fix;
-- :mod:`.chaos` — deterministic fault injector (corrupt / truncate /
-  duplicate / reorder / drop) for the provider/protocol seams, driven by
-  ``YTPU_CHAOS_*`` env knobs and used by the chaos test suite.
+- :mod:`.chaos` — deterministic fault injectors: ``ChaosInjector``
+  (corrupt / truncate / duplicate / reorder / drop) for the
+  provider/protocol seams, driven by ``YTPU_CHAOS_*`` env knobs and
+  used by the chaos test suite, and ``DiskFaultInjector``
+  (disk_tear / disk_bitflip) for WAL files in the crash-recovery
+  harness (ISSUE 3).
 
 The engine-side half (transactional per-doc flush isolation, rollback
 via the ``_demote`` replay machinery) lives in
@@ -31,7 +34,7 @@ like the pre-resilience engine), ``YTPU_RESILIENCE_THRESHOLD``
 
 from __future__ import annotations
 
-from .chaos import ChaosConfig, ChaosInjector  # noqa: F401
+from .chaos import ChaosConfig, ChaosInjector, DiskFaultInjector  # noqa: F401
 from .deadletter import DeadLetter, DeadLetterQueue  # noqa: F401
 from .health import (  # noqa: F401
     DEGRADED,

@@ -189,3 +189,64 @@ class ChaosInjector:
             self._hit("reorder")
             rng.shuffle(out)
         return out
+
+
+class DiskFaultInjector:
+    """File-level faults for the WAL crash harness (ISSUE 3).
+
+    Two faults, matching what disks and crashes actually do to a log:
+    ``tear`` truncates the final bytes of a file mid-record (the torn
+    write a kill leaves on the ACTIVE segment — recovery must truncate
+    at the first bad checksum), and ``bitflip`` flips one byte in place
+    (at-rest corruption of a SEALED segment — recovery must dead-letter
+    the record and resynchronize, never abort).  Same determinism
+    contract as :class:`ChaosInjector`: one seeded PRNG, and every
+    fault is detectable by construction — any single flipped byte fails
+    the record CRC-32.  Counted in the process-global
+    ``ytpu_chaos_faults_total`` family (``disk_tear``/``disk_bitflip``).
+    """
+
+    _DISK_FAULTS = ("disk_tear", "disk_bitflip")
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.fault_counts: dict[str, int] = {
+            f: 0 for f in self._DISK_FAULTS
+        }
+        fam = global_registry().counter(
+            "ytpu_chaos_faults_total",
+            "Faults injected by the chaos harness, by fault kind",
+            labelnames=("fault",),
+        )
+        self._children = {f: fam.labels(fault=f) for f in self._DISK_FAULTS}
+
+    def _hit(self, fault: str) -> None:
+        self.fault_counts[fault] += 1
+        self._children[fault].inc()
+
+    def tear(self, path, max_bytes: int = 64) -> int:
+        """Truncate up to ``max_bytes`` off the end of ``path`` (at
+        least 1).  Returns the bytes removed (0 if the file is empty)."""
+        size = os.path.getsize(path)
+        if size <= 1:
+            return 0
+        cut = self.rng.randrange(1, min(max_bytes, size - 1) + 1)
+        os.truncate(path, size - cut)
+        self._hit("disk_tear")
+        return cut
+
+    def bitflip(self, path, lo: int = 0) -> int:
+        """Flip one random bit of one byte at offset >= ``lo`` in
+        place.  Returns the flipped offset, or -1 if the file has no
+        byte past ``lo``."""
+        size = os.path.getsize(path)
+        if size <= lo:
+            return -1
+        off = self.rng.randrange(lo, size)
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ (1 << self.rng.randrange(8))]))
+        self._hit("disk_bitflip")
+        return off

@@ -113,15 +113,68 @@ class DeadLetterQueue:
         self._q = kept
         return taken
 
-    def snapshot(self) -> dict:
-        """JSON-able summary for exposition/bench artifacts."""
-        return {
+    def snapshot(self, letters: bool = False) -> dict:
+        """JSON-able summary for exposition/bench artifacts.
+
+        ``letters=True`` additionally inlines every queued letter with
+        its update bytes (base64) — the checkpoint-grade dump
+        :meth:`restore` rebuilds from, so ``replay_dead_letters`` keeps
+        working across a crash (ISSUE 3).  The default stays the small
+        summary: exposition must not ship payload bytes."""
+        out = {
             "depth": len(self._q),
             "capacity": self.maxlen,
             "total": self.total,
             "dropped": self.dropped,
             "reasons": self._reason_counts(),
         }
+        if letters:
+            import base64
+
+            out["schema"] = 1
+            out["letters"] = [
+                {
+                    "doc": e.doc,
+                    "v2": e.v2,
+                    "reason": e.reason,
+                    "ts": e.ts,
+                    "update": base64.b64encode(e.update).decode("ascii"),
+                }
+                for e in self._q
+            ]
+        return out
+
+    def restore(self, state: dict) -> int:
+        """Re-enqueue the letters of a :meth:`snapshot(letters=True)`
+        dump (crash recovery).  Restored letters keep their original
+        doc/bytes/v2/reason/timestamp but get fresh seq ids (seqs are a
+        process-local handle, not a durable identity); ``total`` counts
+        them again in this process's ledger.  Returns the number of
+        letters restored (0 for a summary-only snapshot)."""
+        import base64
+
+        restored = 0
+        for e in state.get("letters") or []:
+            try:
+                update = base64.b64decode(e["update"])
+                doc = int(e.get("doc", -1))
+            except (KeyError, TypeError, ValueError):
+                continue
+            entry = DeadLetter(
+                next(self._seq),
+                doc,
+                update,
+                bool(e.get("v2")),
+                str(e.get("reason", "restored")),
+                float(e.get("ts") or time.time()),
+            )
+            self._q.append(entry)
+            self.total += 1
+            restored += 1
+            if len(self._q) > self.maxlen:
+                self._q.popleft()
+                self.dropped += 1
+        return restored
 
     def _reason_counts(self) -> dict[str, int]:
         out: dict[str, int] = {}
