@@ -6,10 +6,11 @@ family at construction) plus the process-global registry, extracts the
 ``ytpu_*`` names from the README Observability table, and fails when
 either side has a name the other lacks — so the docs and the exposition
 surface cannot drift apart.  Also cross-checks the resilience/chaos/
-durability env knobs (``YTPU_CHAOS_*`` / ``YTPU_RESILIENCE_*`` /
-``YTPU_DLQ_*`` / ``YTPU_WAL_*``) read by the code against the knobs
-README documents.  Wired as a tier-1
-check via tests/test_obs.py-adjacent usage and runnable standalone:
+durability/profiling env knobs (``YTPU_CHAOS_*`` / ``YTPU_RESILIENCE_*``
+/ ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` / ``YTPU_PROF_*`` / ``YTPU_SLO_*``)
+read by the code against the knobs README documents.  Wired as a tier-1
+check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
+runnable standalone:
 
     python scripts/check_metrics_schema.py
 """
@@ -45,7 +46,9 @@ def registered_names() -> set[str]:
     )
 
 
-_KNOB_RE = re.compile(r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL)_[A-Z0-9_]+")
+_KNOB_RE = re.compile(
+    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO)_[A-Z0-9_]+"
+)
 
 
 def resilience_knobs_in_code() -> set[str]:

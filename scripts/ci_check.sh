@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Repo CI gate: the metrics/docs schema check plus the fast test tier.
+# Run from anywhere; JAX_PLATFORMS defaults to cpu (override to target
+# an accelerator).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== metrics schema =="
+python scripts/check_metrics_schema.py
+
+echo "== tier-1 tests (not slow) =="
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

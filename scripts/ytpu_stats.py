@@ -10,9 +10,13 @@ Two modes:
 
     python scripts/ytpu_stats.py --demo [--prom|--json]
         Exercise a tiny in-process provider (a few rooms, a sync
-        handshake, one undo) and dump its metrics: the rendered view by
-        default, raw Prometheus text with --prom, the JSON snapshot with
-        --json.  The zero-to-metrics smoke test for the obs subsystem.
+        handshake, one undo, a WAL append, one dead letter) and dump its
+        metrics: the rendered view by default, raw Prometheus text with
+        --prom, the JSON snapshot with --json.  The zero-to-metrics
+        smoke test for the obs subsystem.
+
+``--watch SECONDS`` re-reads and re-renders the snapshot file (or
+re-runs the demo workload) at that interval until interrupted.
 """
 
 from __future__ import annotations
@@ -20,9 +24,24 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# rendered section -> metric-name prefixes it collects; names matching
+# no group land in "other" (a new family renders without a code change)
+GROUPS = (
+    ("engine", ("ytpu_engine_", "ytpu_flush")),
+    ("native planner", ("ytpu_native_",)),
+    ("provider", ("ytpu_provider_",)),
+    ("sync", ("ytpu_sync_",)),
+    ("resilience", ("ytpu_resilience_", "ytpu_doc_", "ytpu_dead_letter",
+                    "ytpu_dlq_", "ytpu_chaos_")),
+    ("durability (WAL)", ("ytpu_wal_",)),
+    ("cost attribution (prof)", ("ytpu_prof_",)),
+    ("convergence SLO", ("ytpu_convergence_", "ytpu_slo_")),
+)
 
 
 def _fmt(v) -> str:
@@ -31,7 +50,37 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _group_of(name: str) -> str:
+    for title, prefixes in GROUPS:
+        if name.startswith(prefixes):
+            return title
+    return "other"
+
+
 def render_snapshot(snap: dict) -> str:
+    """Per-subsystem sections, each mixing that subsystem's counters,
+    gauges, and histogram summaries (one reading order per failure
+    domain instead of one per metric kind)."""
+    by_group: dict[str, list[tuple[str, str]]] = {}
+
+    def add(name, labels_key, val):
+        label = f"{name}{{{labels_key}}}" if labels_key else name
+        by_group.setdefault(_group_of(name), []).append((label, val))
+
+    for name in sorted(snap.get("counters", {})):
+        for labels_key, v in sorted(snap["counters"][name].items()):
+            add(name, labels_key, _fmt(v))
+    for name in sorted(snap.get("gauges", {})):
+        for labels_key, v in sorted(snap["gauges"][name].items()):
+            add(name, labels_key, _fmt(v))
+    for name in sorted(snap.get("histograms", {})):
+        for labels_key, s in sorted(snap["histograms"][name].items()):
+            add(
+                name, labels_key,
+                f"n={s['count']} p50={_fmt(s['p50'])} p95={_fmt(s['p95'])} "
+                f"p99={_fmt(s['p99'])} max={_fmt(s['max'])}",
+            )
+
     lines: list[str] = []
 
     def section(title, rows):
@@ -43,33 +92,24 @@ def render_snapshot(snap: dict) -> str:
             lines.append(f"  {name:<{w}}  {val}")
         lines.append("")
 
-    def flatten(kind_map):
-        rows = []
-        for name in sorted(kind_map):
-            for labels_key, val in sorted(kind_map[name].items()):
-                label = f"{name}{{{labels_key}}}" if labels_key else name
-                rows.append((label, val))
-        return rows
+    for title, _ in GROUPS:
+        section(title, by_group.get(title, []))
+    section("other", by_group.get("other", []))
 
-    section(
-        "counters",
-        [(n, _fmt(v)) for n, v in flatten(snap.get("counters", {}))],
-    )
-    section(
-        "gauges",
-        [(n, _fmt(v)) for n, v in flatten(snap.get("gauges", {}))],
-    )
-    section(
-        "histograms (count / p50 / p95 / p99 / max)",
-        [
-            (
-                n,
-                f"{s['count']} / {_fmt(s['p50'])} / {_fmt(s['p95'])} / "
-                f"{_fmt(s['p99'])} / {_fmt(s['max'])}",
-            )
-            for n, s in flatten(snap.get("histograms", {}))
-        ],
-    )
+    slo = snap.get("slo")
+    if slo:
+        section(
+            "slo verdict",
+            [
+                ("state", slo.get("state", "?")),
+                ("target_ms", _fmt(slo.get("target_ms", 0))),
+                ("burn short/long",
+                 f"{_fmt(slo.get('burn_rates', {}).get('short', 0))} / "
+                 f"{_fmt(slo.get('burn_rates', {}).get('long', 0))}"),
+                ("completed", _fmt(slo.get("completed", 0))),
+                ("pending", _fmt(slo.get("pending", 0))),
+            ],
+        )
     flush = snap.get("flush")
     if flush:
         section(
@@ -81,18 +121,25 @@ def render_snapshot(snap: dict) -> str:
 
 
 def demo_snapshot():
-    """A tiny provider workload touching every instrumented seam."""
+    """A tiny provider workload touching every instrumented seam:
+    flushes, a sync handshake, an undo, WAL appends, and one damaged
+    frame routed to the dead-letter queue — so the durability and
+    resilience sections render non-empty."""
+    import tempfile
+
     from yjs_tpu import Doc
     from yjs_tpu.provider import TpuProvider
     from yjs_tpu.updates import encode_state_as_update
 
-    prov = TpuProvider(4)
+    prov = TpuProvider(4, wal_dir=tempfile.mkdtemp(prefix="ytpu-stats-"))
     for k in range(3):
         d = Doc(gc=False)
         d.get_text("text").insert(0, f"room {k} says hello")
         prov.receive_update(f"room{k}", encode_state_as_update(d))
     prov.flush()
     prov.handle_sync_message("room0", prov.sync_step1("room0"))
+    # a transport-damaged frame: counted + dead-lettered, room survives
+    prov.handle_sync_message("room2", b"\x02\xff\xff\xff")
     prov.enable_undo("room1")
     d = Doc(gc=False)
     d.get_text("text").insert(0, "undo me. ")
@@ -100,6 +147,23 @@ def demo_snapshot():
     prov.flush()
     prov.undo("room1")
     return prov
+
+
+def _watch(render_once, interval: float, iterations: int | None = None,
+           out=None) -> None:
+    """Re-render every ``interval`` seconds (forever when ``iterations``
+    is None; bounded for tests).  Each frame is separated by a ruled
+    timestamp line rather than a screen clear, so output pipes well."""
+    out = out or sys.stdout
+    n = 0
+    while iterations is None or n < iterations:
+        if n:
+            time.sleep(interval)
+        stamp = time.strftime("%H:%M:%S")
+        out.write(f"--- {stamp} ---\n")
+        out.write(render_once())
+        out.flush()
+        n += 1
 
 
 def main(argv=None) -> int:
@@ -114,9 +178,17 @@ def main(argv=None) -> int:
                     help="with --demo: print Prometheus text instead")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="with --demo: print the raw JSON snapshot instead")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
+                    help="re-render at this interval until interrupted")
     args = ap.parse_args(argv)
 
     if args.demo:
+        if args.watch is not None:
+            _watch(
+                lambda: render_snapshot(demo_snapshot().metrics_snapshot()),
+                args.watch,
+            )
+            return 0
         prov = demo_snapshot()
         if args.prom:
             sys.stdout.write(prov.metrics_text())
@@ -128,9 +200,15 @@ def main(argv=None) -> int:
         return 0
     if not args.snapshot:
         ap.error("either a snapshot file or --demo is required")
-    with open(args.snapshot) as f:
-        snap = json.load(f)
-    sys.stdout.write(render_snapshot(snap))
+
+    def render_file():
+        with open(args.snapshot) as f:
+            return render_snapshot(json.load(f))
+
+    if args.watch is not None:
+        _watch(render_file, args.watch)
+        return 0
+    sys.stdout.write(render_file())
     return 0
 
 

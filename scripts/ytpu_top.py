@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""ytpu_top: live fleet dashboard over yjs_tpu provider metrics.
+
+A `top`-style view of one or more providers: flush throughput, queued
+work, convergence latency percentiles, SLO burn-rate verdicts, and the
+resilience / durability counters that page an operator (DLQ depth,
+quarantined rooms, WAL fsync debt).
+
+Sources (one row per provider):
+
+    python scripts/ytpu_top.py snapA.json snapB.json
+        Poll metrics-snapshot JSON files (as written by
+        ``provider.metrics_snapshot()`` — e.g. a sidecar dumping the
+        snapshot to a wellknown path every second).  Files are re-read
+        every ``--interval`` seconds; rates are derived from consecutive
+        reads.
+
+    python scripts/ytpu_top.py --demo
+        Run two in-process providers exchanging sync traffic, one frame
+        of fresh edits per poll — the zero-to-dashboard smoke test.
+
+Renders with curses on a tty, plain text otherwise (or with ``--plain``);
+``--once`` prints a single frame and exits (scripting / CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+COLUMNS = (
+    ("provider", 14),
+    ("flushes", 8),
+    ("docs/s", 8),
+    ("pend", 6),
+    ("conv p50", 9),
+    ("conv p99", 9),
+    ("slo", 8),
+    ("burn", 7),
+    ("dlq", 5),
+    ("quar", 5),
+    ("wal rec", 8),
+    ("occup", 6),
+)
+
+_STATE_NAMES = {0: "ok", 1: "warning", 2: "page"}
+
+
+def _counter(snap: dict, name: str, labels_key: str = "") -> float:
+    return float(snap.get("counters", {}).get(name, {}).get(labels_key, 0))
+
+
+def _gauge(snap: dict, name: str, labels_key: str = "") -> float:
+    return float(snap.get("gauges", {}).get(name, {}).get(labels_key, 0))
+
+
+def _hist(snap: dict, name: str, labels_key: str = "") -> dict | None:
+    return snap.get("histograms", {}).get(name, {}).get(labels_key)
+
+
+def _counter_sum(snap: dict, name: str) -> float:
+    return float(sum(snap.get("counters", {}).get(name, {}).values()))
+
+
+def collect_row(
+    name: str, snap: dict, prev: dict | None, interval: float
+) -> dict:
+    """One dashboard row from a provider snapshot.  ``prev`` is the
+    previous poll's row (its ``totals``) so rates survive file sources
+    that only expose monotonic counters."""
+    flushes = _counter(snap, "ytpu_engine_flushes_total")
+    docs_flushed = _counter(snap, "ytpu_engine_docs_flushed_total")
+    docs_rate = 0.0
+    if prev is not None and interval > 0:
+        docs_rate = max(0.0, docs_flushed - prev["totals"]["docs_flushed"])
+        docs_rate /= interval
+    conv = _hist(snap, "ytpu_convergence_latency_seconds")
+    slo = snap.get("slo") or {}
+    state = slo.get("state")
+    if state is None:
+        state = _STATE_NAMES.get(int(_gauge(snap, "ytpu_slo_state")), "?")
+    burns = slo.get("burn_rates") or {}
+    burn = max(burns.values()) if burns else 0.0
+    return {
+        "provider": name,
+        "flushes": int(flushes),
+        "docs/s": f"{docs_rate:.1f}",
+        "pend": int(_gauge(snap, "ytpu_engine_pending_docs")),
+        "conv p50": f"{conv['p50'] * 1e3:.1f}ms" if conv else "-",
+        "conv p99": f"{conv['p99'] * 1e3:.1f}ms" if conv else "-",
+        "slo": state,
+        "burn": f"{burn:.1f}",
+        "dlq": int(_gauge(snap, "ytpu_resilience_dead_letter_depth")),
+        "quar": int(_gauge(snap, "ytpu_resilience_docs_quarantined")),
+        "wal rec": int(_counter_sum(snap, "ytpu_wal_records_appended_total")),
+        "occup": f"{_gauge(snap, 'ytpu_prof_slot_occupancy'):.2f}",
+        "totals": {"docs_flushed": docs_flushed},
+    }
+
+
+def render(rows: list[dict], interval: float) -> str:
+    """One plain-text frame: header line, column bar, one line per
+    provider, and a worst-verdict footer."""
+    stamp = time.strftime("%H:%M:%S")
+    out = [
+        f"ytpu_top  {stamp}  providers={len(rows)}  "
+        f"interval={interval:g}s"
+    ]
+    out.append("  ".join(f"{title:>{w}}" for title, w in COLUMNS))
+    worst = "ok"
+    for row in rows:
+        out.append(
+            "  ".join(f"{str(row[title]):>{w}}" for title, w in COLUMNS)
+        )
+        order = {"ok": 0, "warning": 1, "page": 2}
+        if order.get(row["slo"], 0) > order.get(worst, 0):
+            worst = row["slo"]
+    out.append(f"fleet verdict: {worst}")
+    return "\n".join(out) + "\n"
+
+
+# -- sources -----------------------------------------------------------------
+
+
+class FileSource:
+    """Re-reads snapshot JSON files each poll (one provider per file)."""
+
+    def __init__(self, paths: list[str]):
+        self.paths = [Path(p) for p in paths]
+
+    def poll(self) -> list[tuple[str, dict]]:
+        out = []
+        for p in self.paths:
+            try:
+                with open(p) as f:
+                    out.append((p.stem, json.load(f)))
+            except (OSError, ValueError):
+                out.append((p.stem, {}))  # unreadable: render an empty row
+        return out
+
+
+class DemoSource:
+    """Two in-process providers trading sync traffic; every poll applies
+    one fresh edit to each and converges them through the real wire."""
+
+    def __init__(self):
+        from yjs_tpu.provider import TpuProvider
+
+        self.a = TpuProvider(8)
+        self.b = TpuProvider(8)
+        self._n = 0
+        # cross-wire the broadcast seams: an update flushed by one
+        # provider is received (and SLO-tracked) by the other
+        self.a.on_update(
+            lambda guid, u: self.b.receive_update(guid, u)
+        )
+        self.b.on_update(
+            lambda guid, u: self.a.receive_update(guid, u)
+        )
+
+    def poll(self) -> list[tuple[str, dict]]:
+        from yjs_tpu.core import Doc
+        from yjs_tpu.updates import encode_state_as_update
+
+        self._n += 1
+        d = Doc(gc=False)
+        d.get_text("text").insert(0, f"edit {self._n} ")
+        u = encode_state_as_update(d)
+        self.a.receive_update(f"room{self._n % 4}", u)
+        self.a.flush()
+        self.b.flush()
+        return [
+            ("provider-a", self.a.metrics_snapshot()),
+            ("provider-b", self.b.metrics_snapshot()),
+        ]
+
+
+# -- drivers -----------------------------------------------------------------
+
+
+def run_plain(source, interval: float, iterations: int | None = None,
+              out=None) -> None:
+    out = out or sys.stdout
+    prev: dict[str, dict] = {}
+    n = 0
+    while iterations is None or n < iterations:
+        if n:
+            time.sleep(interval)
+        rows = [
+            collect_row(name, snap, prev.get(name), interval)
+            for name, snap in source.poll()
+        ]
+        prev = {r["provider"]: r for r in rows}
+        out.write(render(rows, interval))
+        out.flush()
+        n += 1
+
+
+def run_curses(source, interval: float) -> None:  # pragma: no cover - tty
+    import curses
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        prev: dict[str, dict] = {}
+        while True:
+            rows = [
+                collect_row(name, snap, prev.get(name), interval)
+                for name, snap in source.poll()
+            ]
+            prev = {r["provider"]: r for r in rows}
+            scr.erase()
+            for y, line in enumerate(render(rows, interval).splitlines()):
+                try:
+                    scr.addnstr(y, 0, line, curses.COLS - 1)
+                except curses.error:
+                    break  # terminal shrank below the frame
+            scr.refresh()
+            t_end = time.time() + interval
+            while time.time() < t_end:
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(loop)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ytpu_top", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("snapshots", nargs="*",
+                    help="provider metrics-snapshot JSON files to poll")
+    ap.add_argument("--demo", action="store_true",
+                    help="dashboard over two in-process demo providers")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--plain", action="store_true",
+                    help="plain text frames even on a tty")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        source = DemoSource()
+    elif args.snapshots:
+        source = FileSource(args.snapshots)
+    else:
+        ap.error("either snapshot files or --demo is required")
+
+    if args.once:
+        run_plain(source, args.interval, iterations=1)
+        return 0
+    if args.plain or not sys.stdout.isatty():
+        run_plain(source, args.interval)
+        return 0
+    run_curses(source, args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

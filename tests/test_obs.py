@@ -173,12 +173,15 @@ def test_chrome_trace_json_valid_and_phased():
     events = loaded["traceEvents"]
     assert events
     ts = [e["ts"] for e in events]
-    assert ts == sorted(ts)  # monotonic
+    assert ts == sorted(ts)  # monotonic (metadata events sit at ts 0.0)
     for e in events:
-        assert e["ph"] in ("X", "i")
+        assert e["ph"] in ("X", "i", "M", "s", "f")
         if e["ph"] == "X":  # complete events carry a duration
             assert e["dur"] >= 0.0
         assert {"name", "pid", "tid", "cat"} <= set(e)
+    # pid/tid metadata present so Perfetto names the process lanes
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {m["name"] for m in meta} >= {"process_name", "thread_name"}
     names = [e["name"] for e in events]
     # one flush span per flush, one span per host phase per flush
     assert names.count("ytpu.flush") == n_flushes

@@ -228,6 +228,25 @@ class EngineObs:
             "ytpu_resilience_replayed_total",
             "Dead letters successfully re-integrated by replay()",
         )
+        # device-memory cost attribution (ISSUE 4): refreshed once per
+        # flush from the engine's persistent device buffers
+        self._device_table_bytes = r.gauge(
+            "ytpu_prof_device_table_bytes",
+            "Live device bytes per persistent doc-table column group",
+            unit="bytes",
+            labelnames=("table",),
+        )
+        self._device_bytes_total = r.gauge(
+            "ytpu_prof_device_bytes_total",
+            "Total live persistent device bytes, by backend platform",
+            unit="bytes",
+            labelnames=("backend",),
+        )
+        self._slot_occupancy = r.gauge(
+            "ytpu_prof_slot_occupancy",
+            "Fraction of engine doc slots holding live rows",
+            unit="ratio",
+        )
 
     # -- hot-path recording hooks -------------------------------------
 
@@ -265,6 +284,19 @@ class EngineObs:
             return
         self._native_prepare_seconds.observe(dt_s)
         self._native_prepare_docs.observe(n_docs)
+
+    def device_memory(
+        self, tables: dict, backend: str, occupancy: float
+    ) -> None:
+        """Per-table live device bytes + slot occupancy (post-flush)."""
+        if not self.enabled:
+            return
+        total = 0
+        for table, nbytes in tables.items():
+            self._device_table_bytes.labels(table=table).set(nbytes)
+            total += nbytes
+        self._device_bytes_total.labels(backend=backend).set(total)
+        self._slot_occupancy.set(occupancy)
 
     # -- resilience hooks ----------------------------------------------
 

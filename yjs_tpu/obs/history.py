@@ -9,6 +9,7 @@ object, not a copy; ``snapshot()`` returns copies for export).
 from __future__ import annotations
 
 import os
+import threading
 from collections import deque
 
 DEFAULT_HISTORY = 128
@@ -23,9 +24,13 @@ def history_len_from_env() -> int:
 
 
 class FlushHistory:
-    """FIFO ring of per-flush metric dicts (oldest evicted first)."""
+    """FIFO ring of per-flush metric dicts (oldest evicted first).
 
-    __slots__ = ("_ring", "total")
+    ``append`` and ``snapshot`` are lock-guarded: exposition scrapes run
+    from other threads while a flush appends, and deque iteration raises
+    on concurrent mutation (a torn scrape, not just a stale one)."""
+
+    __slots__ = ("_ring", "total", "_lock")
 
     def __init__(self, maxlen: int | None = None):
         if maxlen is None:
@@ -33,6 +38,7 @@ class FlushHistory:
         self._ring: deque = deque(maxlen=maxlen)
         # flushes ever recorded (monotonic; ring length caps at maxlen)
         self.total = 0
+        self._lock = threading.Lock()
 
     @property
     def maxlen(self) -> int:
@@ -44,8 +50,9 @@ class FlushHistory:
         return self._ring[-1] if self._ring else None
 
     def append(self, metrics: dict) -> None:
-        self._ring.append(metrics)
-        self.total += 1
+        with self._lock:
+            self._ring.append(metrics)
+            self.total += 1
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -58,4 +65,5 @@ class FlushHistory:
 
     def snapshot(self) -> list[dict]:
         """Oldest-to-newest copies, safe to serialize or mutate."""
-        return [dict(m) for m in self._ring]
+        with self._lock:
+            return [dict(m) for m in self._ring]

@@ -49,6 +49,7 @@ class _Span:
             (t1 - self._t0) * 1e6,
             threading.get_ident(),
             self._args,
+            None,
         ))
         return False
 
@@ -78,10 +79,12 @@ class Tracer:
                 )
             except ValueError:
                 max_events = DEFAULT_MAX_EVENTS
-        # (name, ph, ts_us, dur_us, tid, args) tuples
+        # (name, ph, ts_us, dur_us, tid, args, flow_id) tuples
         self._events: deque = deque(maxlen=max(16, max_events))
         self._t0 = time.perf_counter()
         self.pid = os.getpid()
+        self.process_name = "ytpu"
+        self._thread_names: dict[int, str] = {}
         if enabled and os.environ.get("YTPU_TRACE_PATH"):
             _register_for_exit_dump(self)
 
@@ -102,7 +105,37 @@ class Tracer:
             0.0,
             threading.get_ident(),
             args or None,
+            None,
         ))
+
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        """Open a flow arrow (Perfetto ``ph="s"``): call inside the span
+        the arrow should leave from (e.g. a provider receive span)."""
+        self._flow(name, "s", flow_id, args)
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
+        """Close a flow arrow (``ph="f"``, ``bp="e"`` so it binds to the
+        enclosing slice): call inside the span the arrow lands on (the
+        flush that applied the update)."""
+        self._flow(name, "f", flow_id, args)
+
+    def _flow(self, name, ph, flow_id, args) -> None:
+        if not self.enabled:
+            return
+        self._events.append((
+            name,
+            ph,
+            (time.perf_counter() - self._t0) * 1e6,
+            0.0,
+            threading.get_ident(),
+            args or None,
+            int(flow_id),
+        ))
+
+    def name_thread(self, name: str) -> None:
+        """Label the calling thread in exported traces (a ``thread_name``
+        metadata event; unnamed threads render as ``host-<tid>``)."""
+        self._thread_names[threading.get_ident()] = name
 
     def __len__(self) -> int:
         return len(self._events)
@@ -111,9 +144,26 @@ class Tracer:
         self._events.clear()
 
     def trace_events(self) -> list[dict]:
-        """Chrome ``traceEvents`` list, sorted by timestamp."""
+        """Chrome ``traceEvents`` list: ``pid``/``tid`` metadata ("M")
+        events first, then recorded events sorted by timestamp."""
+        if not self._events:
+            return []
         out = []
-        for name, ph, ts, dur, tid, args in sorted(
+        tids = sorted({e[4] for e in self._events})
+        meta = [{
+            "name": "process_name", "ph": "M", "ts": 0.0,
+            "pid": self.pid, "tid": tids[0], "cat": "__metadata",
+            "args": {"name": self.process_name},
+        }]
+        for tid in tids:
+            meta.append({
+                "name": "thread_name", "ph": "M", "ts": 0.0,
+                "pid": self.pid, "tid": tid, "cat": "__metadata",
+                "args": {
+                    "name": self._thread_names.get(tid, f"host-{tid}")
+                },
+            })
+        for name, ph, ts, dur, tid, args, flow_id in sorted(
             self._events, key=lambda e: e[2]
         ):
             ev = {
@@ -126,12 +176,17 @@ class Tracer:
             }
             if ph == "X":
                 ev["dur"] = dur
-            else:  # instant events: thread scope
+            elif ph == "i":  # instant events: thread scope
                 ev["s"] = "t"
+            if flow_id is not None:
+                ev["id"] = flow_id
+            if ph == "f":
+                # bind the arrow to the ENCLOSING slice, not the next one
+                ev["bp"] = "e"
             if args:
                 ev["args"] = args
             out.append(ev)
-        return out
+        return meta + out
 
     def chrome_trace(self) -> dict:
         """The full Chrome-trace JSON object (loadable by Perfetto)."""

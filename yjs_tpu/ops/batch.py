@@ -21,6 +21,7 @@ subdocuments (ContentDoc) fall back to the scratch-doc oracle internally
 
 from __future__ import annotations
 
+from ..obs.prof import host_timed
 from .columns import DocMirror, UnsupportedUpdate
 
 
@@ -34,6 +35,7 @@ def _loaded_mirror(updates: list[bytes], v2: bool):
     return m
 
 
+@host_timed("merge_updates")
 def merge_updates_columnar(
     updates: list[bytes], v2: bool = False, out_v2: bool | None = None
 ) -> bytes:
@@ -54,6 +56,7 @@ def merge_updates_columnar(
     return m.encode_state_as_update(v2=ov2)
 
 
+@host_timed("diff_update")
 def diff_update_columnar(
     update: bytes, encoded_state_vector: bytes, v2: bool = False
 ) -> bytes:
@@ -72,6 +75,7 @@ def diff_update_columnar(
     )
 
 
+@host_timed("encode_state_vector_from_update")
 def encode_state_vector_from_update_columnar(
     update: bytes, v2: bool = False
 ) -> bytes:

@@ -30,6 +30,7 @@ except Exception:  # pragma: no cover
 from ..core import Doc
 from ..lib0.u16 import from_u16
 from ..obs import EngineObs, new_flush_metrics
+from ..obs.prof import profiled
 from ..resilience import DeadLetterQueue, HealthTracker
 from ..updates import InvalidUpdate, validate_update
 from ..updates import apply_update, apply_update_v2
@@ -143,6 +144,7 @@ _STATIC_COLS = (
 if HAS_JAX:
     import functools
 
+    @profiled("scatter_statics")
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _scatter_statics(statics, packed):
         """All six resident-column updates in ONE device dispatch from ONE
@@ -299,6 +301,9 @@ class BatchEngine:
         self._statics: dict | None = None
         # rows per doc already uploaded and still valid on device
         self._uploaded_rows = [0] * n_docs
+        # slots that ever accepted traffic (cleared by reset_doc): feeds
+        # the ytpu_prof_slot_occupancy gauge in O(1) per update
+        self._active_docs: set[int] = set()
 
     # -- update ingestion ---------------------------------------------------
 
@@ -336,6 +341,7 @@ class BatchEngine:
         else:
             self._update_log[doc].append((update, v2))
             self.mirrors[doc].ingest(update, v2)
+        self._active_docs.add(doc)
         return True
 
     def _dead_letter(self, doc: int, update: bytes, v2: bool, reason: str) -> None:
@@ -780,6 +786,7 @@ class BatchEngine:
         self._update_log[doc] = []
         self._uploaded_rows[doc] = 0
         self._rows_at_compact[doc] = 0
+        self._active_docs.discard(doc)
         self._event_listeners.pop(doc, None)
         self.health.reset(doc)
         if self._right is not None:
@@ -802,6 +809,37 @@ class BatchEngine:
         """The single exit point of every flush path: append to the flush
         ring (which serves last_flush_metrics) + update the registry."""
         self.obs.record_flush(metrics, row_capacity=self._cap)
+        if self.obs.enabled:
+            self._record_device_memory()
+
+    def _record_device_memory(self) -> None:
+        """Refresh the ytpu_prof device-memory gauges from the persistent
+        device buffers (ISSUE 4 cost attribution).  Reads array metadata
+        only — no device sync; accounting must never break a flush."""
+        right = self._right
+        if right is None:
+            return
+        try:
+            tables = {
+                "right_link": int(right.nbytes),
+                "deleted": int(self._deleted.nbytes),
+                "starts": int(self._starts.nbytes),
+            }
+            if self._statics is not None:
+                tables["statics"] = int(
+                    sum(v.nbytes for v in self._statics.values())
+                )
+            try:
+                backend = next(iter(right.devices())).platform
+            except Exception:
+                backend = "unknown"
+            self.obs.device_memory(
+                tables,
+                backend,
+                len(self._active_docs) / max(1, self.n_docs),
+            )
+        except Exception:
+            pass
 
     def flush(self) -> None:
         with self.obs.tracer.span("ytpu.flush"):

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.prof import profiled
 from .columns import GATHER_SUCC
 
 NULL = -1
@@ -322,6 +323,7 @@ def _doc_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     return right_link, deleted, starts
 
 
+@profiled("batch_step")
 @functools.partial(jax.jit, donate_argnums=(1,))
 def batch_step(statics, dyn, splits, sched, delete_rows):
     """vmapped per-item integration step over the doc batch.
@@ -331,6 +333,7 @@ def batch_step(statics, dyn, splits, sched, delete_rows):
     return jax.vmap(_doc_step)(statics, dyn, splits, sched, delete_rows)
 
 
+@profiled("batch_step_levels")
 @functools.partial(jax.jit, donate_argnums=(1,))
 def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base):
     """vmapped level-parallel integration step (the default engine path).
@@ -343,6 +346,7 @@ def batch_step_levels(statics, dyn, splits, lv_sched, delete_rows, scratch_base)
     )
 
 
+@profiled("batch_step_levels_shared")
 @functools.partial(jax.jit, donate_argnums=(1,))
 def batch_step_levels_shared(
     statics, dyn, splits, lv_sched, delete_rows, scratch_base
@@ -378,6 +382,7 @@ def _doc_lanes(counts, k, cap_oob):
     return d, within
 
 
+@profiled("apply_plan2")
 @functools.partial(
     jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=(0,)
 )
@@ -440,6 +445,7 @@ def apply_lanes(dyn, lanes, k_dn, k_sp, k_h, k_d):
     return right_link, deleted, starts
 
 
+@profiled("apply_plan_shared")
 @functools.partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(0,))
 def apply_plan_shared(dyn, lanes, k_l, k_h, k_d):
     """Broadcast bulk apply: ONE doc's resolved deltas fanned out to every
@@ -502,9 +508,10 @@ def list_ranks(right_link, valid):
     return jnp.where(valid, d, NULL)
 
 
-list_ranks = jax.jit(list_ranks)
+list_ranks = profiled("list_ranks")(jax.jit(list_ranks))
 
 
+@profiled("state_vector_kernel")
 @functools.partial(jax.jit, static_argnums=(2,))
 def state_vector_kernel(row_slot, row_end, n_slots):
     """Dense per-doc state vectors: sv[b, slot] = max(clock+len) over rows —
@@ -523,6 +530,7 @@ def state_vector_kernel(row_slot, row_end, n_slots):
     return sv[:, :n_slots]
 
 
+@profiled("diff_mask_kernel")
 @jax.jit
 def diff_mask_kernel(row_slot, row_clock, row_end, sv):
     """Rows (or row suffixes) missing from a remote state vector: the

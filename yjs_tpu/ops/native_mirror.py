@@ -799,8 +799,12 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True,
         1 if want_levels else 0, 1 if want_sched else 0, _p64(counts),
         _p64(rcs),
     )
+    dt = time.perf_counter() - t0
     if obs is not None:
-        obs.native_prepare(n, time.perf_counter() - t0)
+        obs.native_prepare(n, dt)
+    from ..obs.prof import kernel_profiler
+
+    kernel_profiler().record_host_op("prepare_many", dt)
     return counts, rcs, staged_info
 
 

@@ -37,6 +37,7 @@ except ImportError:  # pragma: no cover - older jax
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
         )
 
+from ..obs.prof import profiled
 from ..ops import kernels
 
 
@@ -89,7 +90,9 @@ def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
         out_specs=((spec, spec, spec), P()),
     )
     # donate the persistent dyn buffers like kernels.batch_step does
-    return jax.jit(sharded, donate_argnums=(1,))
+    return profiled("sharded_batch_step")(
+        jax.jit(sharded, donate_argnums=(1,))
+    )
 
 
 def sharded_apply_plan(mesh: Mesh, axis: str, k_dn: int, k_sp: int,
@@ -121,7 +124,9 @@ def sharded_apply_plan(mesh: Mesh, axis: str, k_dn: int, k_sp: int,
         in_specs=((spec, spec, spec), spec),
         out_specs=((spec, spec, spec), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return profiled("sharded_apply_plan")(
+        jax.jit(sharded, donate_argnums=(0,))
+    )
 
 
 def sharded_state_vectors(mesh: Mesh, n_slots: int, axis: str = "docs", row_axis: str | None = None):
@@ -141,11 +146,13 @@ def sharded_state_vectors(mesh: Mesh, n_slots: int, axis: str = "docs", row_axis
     else:
         in_spec = P(axis, row_axis)
         out_spec = P(axis)
-    return jax.jit(
-        shard_map(
-            local_sv,
-            mesh=mesh,
-            in_specs=(in_spec, in_spec),
-            out_specs=out_spec,
+    return profiled("sharded_state_vectors")(
+        jax.jit(
+            shard_map(
+                local_sv,
+                mesh=mesh,
+                in_specs=(in_spec, in_spec),
+                out_specs=out_spec,
+            )
         )
     )

@@ -35,6 +35,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
+import time
 from pathlib import Path
 
 from .records import (
@@ -116,6 +118,7 @@ class WalMetrics:
             self.segments = self.compactions = self.reclaimed = noop
             self.recoveries = self.replayed = noop
             self.torn = self.corrupt = self.replay_seconds = noop
+            self.append_seconds = noop
             return
         self.records = registry.counter(
             "ytpu_wal_records_appended_total",
@@ -170,6 +173,11 @@ class WalMetrics:
             "Wall time of one recovery replay (snapshot + tail)",
             unit="s",
         )
+        self.append_seconds = registry.histogram(
+            "ytpu_wal_append_seconds",
+            "Wall time of one WAL append (encode + write + policy fsync)",
+            unit="s",
+        )
 
 
 def list_segments(path) -> list[tuple[int, Path]]:
@@ -203,11 +211,15 @@ class WriteAheadLog:
     tail can be truncated by recovery without racing the live writer.
     """
 
-    def __init__(self, path, config: WalConfig | None = None, metrics=None):
+    def __init__(self, path, config: WalConfig | None = None, metrics=None,
+                 tracer=None):
         self.dir = Path(path)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.config = config if config is not None else WalConfig()
         self.metrics = metrics if metrics is not None else WalMetrics(None)
+        # optional host tracer (yjs_tpu.obs.Tracer): journal latency
+        # becomes a span inside the provider's receive/flush timeline
+        self._tracer = tracer
         existing = list_segments(self.dir)
         ckpts = list_checkpoints(self.dir)
         self._next_index = max(
@@ -250,6 +262,7 @@ class WriteAheadLog:
             raise RuntimeError("WAL abandoned (simulated crash)")
         if self._closed:
             raise RuntimeError("WAL is closed")
+        t0 = time.perf_counter()
         rec = encode_record(kind, guid, payload, v2)
         if self._f is None or self._size >= self.config.segment_bytes:
             self._seal()
@@ -269,6 +282,16 @@ class WriteAheadLog:
         ):
             os.fsync(self._f.fileno())
             self.metrics.fsyncs.inc()
+        dt = time.perf_counter() - t0
+        self.metrics.append_seconds.observe(dt)
+        if self._tracer is not None and self._tracer.enabled:
+            # record as a completed span (retroactively: the duration is
+            # already known, no context-manager overhead on the hot path)
+            self._tracer._events.append((
+                "ytpu.wal.append", "X",
+                (t0 - self._tracer._t0) * 1e6, dt * 1e6,
+                threading.get_ident(), {"kind": KIND_NAMES[kind]}, None,
+            ))
 
     # -- compaction ----------------------------------------------------------
 

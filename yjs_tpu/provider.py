@@ -18,6 +18,7 @@ import os
 from .lib0.decoding import Decoder
 from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
+from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
     KIND_RELEASE,
@@ -134,6 +135,10 @@ class TpuProvider:
         )
         # slots freed by release_doc, reused before _next advances
         self._free: list[int] = []
+        # end-to-end convergence SLO tracker (ISSUE 4): updates are keyed
+        # by their natural (client, clock) first-struct id, so origin /
+        # receive / integrate / visible timestamps need ZERO wire changes
+        self.slo = ConvergenceTracker(r, tracer=self.engine.obs.tracer)
         # WAL metric families register unconditionally (exposition and
         # the schema checker must see them WAL or no WAL); the journal
         # itself attaches only when a directory is configured
@@ -141,7 +146,10 @@ class TpuProvider:
         if wal_dir is None:
             wal_dir = os.environ.get("YTPU_WAL_DIR")
         self.wal: WriteAheadLog | None = (
-            WriteAheadLog(wal_dir, wal_config, self._wal_metrics)
+            WriteAheadLog(
+                wal_dir, wal_config, self._wal_metrics,
+                tracer=self.engine.obs.tracer,
+            )
             if wal_dir
             else None
         )
@@ -174,9 +182,14 @@ class TpuProvider:
         """Register ``callback(guid, update_bytes)``: the flush-emitted
         incremental update per room — the server's broadcast-to-peers seam
         (a transport pushes these as MESSAGE_YJS_UPDATE frames)."""
-        self.engine.on_update(
-            lambda doc, update: callback(self._guid_of[doc], update)
-        )
+        def bridge(doc, update):
+            # stamp the ORIGIN timestamp the moment the update is born:
+            # a peer provider receiving these bytes measures end-to-end
+            # convergence from here (obs/slo.py; in-process floor)
+            self.slo.origin(update)
+            callback(self._guid_of[doc], update)
+
+        self.engine.on_update(bridge)
 
     def observe(self, guid: str, path, callback):
         """Register ``callback(guid, event)`` for events whose path starts
@@ -225,21 +238,27 @@ class TpuProvider:
         :meth:`replay_dead_letters`; the undo replica is only fed
         accepted updates so it cannot diverge from the room."""
         doc = self.doc_id(guid)
-        if self.wal is not None:
-            # journal BEFORE integrating (write-ahead): a crash between
-            # append and flush replays the update; the reverse order
-            # could integrate state the log never saw
-            self.wal.append(KIND_UPDATE, guid, update, v2=v2)
-        accepted = self.engine.queue_update(doc, update, v2=v2)
-        self._m_updates_rx.inc()
-        self._m_ingress_bytes.inc(len(update))
-        if not accepted:
-            return False
-        self._dirty = True
-        ru = self._undo.get(guid)
-        if ru is not None:
-            ru.apply_update(update, tracked=undoable, v2=v2)
-        return True
+        with self.engine.obs.tracer.span(
+            "ytpu.provider.receive_update", guid=guid
+        ):
+            key = self.slo.receive(update, v2=v2, guid=guid)
+            if self.wal is not None:
+                # journal BEFORE integrating (write-ahead): a crash between
+                # append and flush replays the update; the reverse order
+                # could integrate state the log never saw
+                self.wal.append(KIND_UPDATE, guid, update, v2=v2)
+            accepted = self.engine.queue_update(doc, update, v2=v2)
+            self._m_updates_rx.inc()
+            self._m_ingress_bytes.inc(len(update))
+            if not accepted:
+                self.slo.rejected(key)
+                return False
+            self.slo.integrated(key)
+            self._dirty = True
+            ru = self._undo.get(guid)
+            if ru is not None:
+                ru.apply_update(update, tracked=undoable, v2=v2)
+            return True
 
     # -- server-side undo ---------------------------------------------------
 
@@ -344,8 +363,14 @@ class TpuProvider:
             # check below does) must not leave the provider re-flushing
             # already-integrated work forever
             self._dirty = False
+            tracer = self.engine.obs.tracer
             try:
-                self.engine.flush()
+                with tracer.span("ytpu.provider.flush"):
+                    self.engine.flush()
+                    # visibility stamps (and the flow-arrow landings)
+                    # belong INSIDE the flush span: this is the moment
+                    # the queued updates became readable
+                    self.slo.visible(tracer=tracer)
             except Exception:
                 self._dirty = True  # flush incomplete: retry next call
                 raise
@@ -429,12 +454,16 @@ class TpuProvider:
                 )
                 return None
             self._m_ingress_bytes.inc(len(u))
+            key = self.slo.receive(u, guid=guid)
             if self.wal is not None:
                 # journal the PAYLOAD, post-validation: transport damage
                 # (dead-lettered above) never enters the durable log
                 self.wal.append(KIND_UPDATE, guid, u)
             if self.engine.queue_update(doc, u):
                 self._dirty = True
+                self.slo.integrated(key)
+            else:
+                self.slo.rejected(key)
             return None
         # unknown frame type (newer protocol revision, or a corrupted
         # type varint): count and skip — a hostile peer must not be able
@@ -639,8 +668,16 @@ class TpuProvider:
 
     def metrics_snapshot(self) -> dict:
         """JSON-able snapshot of the whole stack (see
-        BatchEngine.metrics_snapshot)."""
-        return self.engine.metrics_snapshot()
+        BatchEngine.metrics_snapshot), plus the provider's convergence
+        SLO state under ``"slo"``."""
+        snap = self.engine.metrics_snapshot()
+        snap["slo"] = self.slo.snapshot()
+        return snap
+
+    def slo_snapshot(self) -> dict:
+        """Convergence-SLO state: target, per-window burn rates, and the
+        ok/warning/page verdict (see :class:`yjs_tpu.obs.slo.ConvergenceTracker`)."""
+        return self.slo.snapshot()
 
     # -- resilience surface (ISSUE 2) ---------------------------------------
 

@@ -65,9 +65,30 @@ def read_sync_step1(decoder: Decoder, encoder: Encoder, doc: Doc) -> None:
     write_sync_step2(encoder, doc, decoding.read_var_uint8_array(decoder))
 
 
-def read_sync_step2(decoder: Decoder, doc: Doc, transaction_origin=None) -> None:
+def read_sync_step2(decoder: Decoder, doc: Doc, transaction_origin=None,
+                    slo=None) -> None:
     _count("read", MESSAGE_YJS_SYNC_STEP_2)
-    apply_update(doc, decoding.read_var_uint8_array(decoder), transaction_origin)
+    _apply(decoder, doc, transaction_origin, slo)
+
+
+def _apply(decoder: Decoder, doc: Doc, transaction_origin, slo) -> None:
+    """Apply one framed update payload, optionally stamping convergence
+    timestamps on a :class:`yjs_tpu.obs.slo.ConvergenceTracker` — the
+    receive seam for CPU-doc deployments (a Doc integrates synchronously,
+    so receive → integrate → visible collapse into this one call; the
+    bytes on the wire are untouched)."""
+    u = decoding.read_var_uint8_array(decoder)
+    if slo is None:
+        apply_update(doc, u, transaction_origin)
+        return
+    key = slo.receive(u)
+    try:
+        apply_update(doc, u, transaction_origin)
+    except Exception:
+        slo.rejected(key)
+        raise
+    slo.integrated(key)
+    slo.visible()
 
 
 def write_update(encoder: Encoder, update: bytes) -> None:
@@ -76,14 +97,16 @@ def write_update(encoder: Encoder, update: bytes) -> None:
     _count("write", MESSAGE_YJS_UPDATE)
 
 
-def read_update_message(decoder: Decoder, doc: Doc, transaction_origin=None) -> None:
+def read_update_message(decoder: Decoder, doc: Doc, transaction_origin=None,
+                        slo=None) -> None:
     """Same wire handling as read_sync_step2 (an update IS a partial
     step-2 payload); counted separately so frame-type traffic is visible."""
     _count("read", MESSAGE_YJS_UPDATE)
-    apply_update(doc, decoding.read_var_uint8_array(decoder), transaction_origin)
+    _apply(decoder, doc, transaction_origin, slo)
 
 
-def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_origin=None) -> int:
+def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc,
+                      transaction_origin=None, slo=None) -> int:
     """Dispatch one sync frame; returns its message type.
 
     Tolerant by contract (y-protocols sync.js readSyncMessage logs and
@@ -97,9 +120,9 @@ def read_sync_message(decoder: Decoder, encoder: Encoder, doc: Doc, transaction_
     if message_type == MESSAGE_YJS_SYNC_STEP_1:
         read_sync_step1(decoder, encoder, doc)
     elif message_type == MESSAGE_YJS_SYNC_STEP_2:
-        read_sync_step2(decoder, doc, transaction_origin)
+        read_sync_step2(decoder, doc, transaction_origin, slo=slo)
     elif message_type == MESSAGE_YJS_UPDATE:
-        read_update_message(decoder, doc, transaction_origin)
+        read_update_message(decoder, doc, transaction_origin, slo=slo)
     else:
         _count("read", message_type)
         return MESSAGE_UNKNOWN
