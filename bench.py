@@ -763,6 +763,89 @@ def bench_obs_prof(n_ops: int = 200) -> dict:
     }
 
 
+def bench_network(n_ops: int = 200) -> dict:
+    """Session-layer cost (ISSUE 5): the same cross-provider fan-out
+    through per-room :class:`SyncSession` pairs over an in-memory pipe,
+    once on a clean wire and once through the network fault injector
+    (drop + dup + reorder) — the lossy run's extra wall time is what
+    ack/retransmit + anti-entropy pay to still converge exactly."""
+    import gc
+
+    from yjs_tpu.provider import TpuProvider
+    from yjs_tpu.resilience import NetChaosConfig, NetworkFaultInjector
+    from yjs_tpu.sync import PipeNetwork, SessionConfig
+
+    n_docs = int(os.environ.get("YTPU_BENCH_NET_DOCS", "16"))
+    updates = load_distinct_traces(n_docs, n_ops)
+    # retry_base must exceed the pipe's 2-round ack RTT or every frame
+    # retransmits once "spuriously"; idle_rounds must outlast the worst
+    # backoff gap (retry_cap * (1+jitter)) so settle keeps ticking
+    # through droughts where every in-flight copy was dropped.
+    # anti-entropy stays OFF: its digest cadence keeps the wire busy
+    # forever, so settle would never idle out and the rounds delta
+    # (the recovery-cost number this bench reports) would be noise —
+    # retransmission alone owns loss recovery here
+    cfg = SessionConfig(
+        heartbeat=0, liveness=0, antientropy=0, retry_base=4,
+        retry_cap=16, seed=11,
+    )
+
+    def run(injector) -> dict:
+        gc.collect()
+        a = TpuProvider(n_docs)
+        b = TpuProvider(n_docs)
+        net = PipeNetwork(injector)
+        for i in range(n_docs):
+            t1, t2 = net.pair()
+            a.session(f"room-{i}", "b", cfg).connect(t1)
+            b.session(f"room-{i}", "a", cfg).connect(t2)
+
+        def drive():
+            a.flush()
+            b.flush()
+            a.tick_sessions()
+            b.tick_sessions()
+
+        t0 = time.perf_counter()
+        net.settle((drive,))
+        for i, u in enumerate(updates):
+            a.receive_update(f"room-{i}", u)
+        rounds = net.settle((drive,), max_rounds=5000, idle_rounds=40)
+        dt = time.perf_counter() - t0
+        converged = all(
+            a.text(f"room-{i}") == b.text(f"room-{i}")
+            for i in range(n_docs)
+        )
+        rows = a.sessions_snapshot() + b.sessions_snapshot()
+        return {
+            "elapsed_s": round(dt, 4),
+            "rounds": rounds,
+            "converged": converged,
+            "frames_sent": sum(r["sent"] for r in rows),
+            "retransmits": sum(r["retransmits"] for r in rows),
+            "repairs": sum(r["repairs"] for r in rows),
+            "dead_lettered": sum(r["dead_lettered"] for r in rows),
+        }
+
+    clean = run(None)
+    lossy = run(
+        NetworkFaultInjector(
+            NetChaosConfig(
+                seed=11, drop=0.1, duplicate=0.05, reorder=0.2
+            )
+        )
+    )
+    return {
+        "n_docs": n_docs,
+        "trace_ops": n_ops,
+        "clean": clean,
+        "lossy": lossy,
+        # round-based (deterministic): wall time mixes in flush JIT
+        # warmup, which the clean run pays for both
+        "loss_recovery_overhead_rounds": lossy["rounds"] - clean["rounds"],
+    }
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -814,6 +897,8 @@ def main():
     resilience = bench_resilience()
     time.sleep(3)
     durability = bench_durability()
+    time.sleep(3)
+    network = bench_network()
     time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
@@ -875,6 +960,7 @@ def main():
             "obs_prof": obs_prof,
             "resilience": resilience,
             "durability": durability,
+            "network": network,
         },
     }
     if sweep is not None:

@@ -1,15 +1,20 @@
-"""SocketConnector: a TCP transport on the AbstractConnector base.
+"""SocketConnector: a TCP transport on the AbstractConnector base,
+carried by the session layer (``yjs_tpu.sync.session``).
 
 A second transport example beyond ``server_demo.py``'s in-process
 provider: each peer binds one ``Y.Doc`` to a length-prefixed TCP framing
-of the y-protocols sync messages (step 1 / step 2 / incremental update —
-``yjs_tpu.sync.protocol``), so the wire bytes are exactly what a JS
-``y-websocket`` peer would exchange.
+of the sync messages.  Since ISSUE 5 the frames ride a
+:class:`~yjs_tpu.sync.session.SyncSession`, so this connector gets
+ack-based retransmission, heartbeat/liveness detection, backpressure
+coalescing, and the anti-entropy repair loop for free — while the inner
+frames stay exactly what a JS ``y-websocket`` peer would exchange: a
+peer that never speaks the session envelope is detected by its bare
+step 1 and the session negotiates down to the plain protocol.
 
 Run in two terminals (the first becomes the listener):
 
-    python examples/socket_connector.py serve 47800
-    python examples/socket_connector.py join  47800
+    python examples/socket_connector.py server 47800
+    python examples/socket_connector.py client 47800
 
 Both processes make concurrent edits and print the converged text.
 Reference seams: src/utils/AbstractConnector.js:16-26 (the base),
@@ -19,6 +24,7 @@ y-protocols/sync.js (the message flow the protocol module mirrors).
 from __future__ import annotations
 
 import os
+import queue
 import socket
 import struct
 import sys
@@ -27,21 +33,27 @@ import threading
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import yjs_tpu as Y
-from yjs_tpu.lib0.decoding import Decoder
-from yjs_tpu.lib0.encoding import Encoder
-from yjs_tpu.sync import protocol
+from yjs_tpu.sync.session import DocSessionHost, SessionConfig, SyncSession
+from yjs_tpu.sync.transport import CallbackTransport
 from yjs_tpu.utils.abstract_connector import AbstractConnector
+
+# seconds of wall time per session tick: with the default knobs that
+# makes a heartbeat every ~0.4s, a liveness timeout after ~1.6s of
+# silence, and first retransmission of a lost frame after ~0.1s
+TICK_SECONDS = 0.05
 
 
 class SocketConnector(AbstractConnector):
-    """Bind one doc to one TCP peer: handshake on connect, then stream
-    local transactions as incremental update frames.
+    """Bind one doc to one TCP peer through a resumable session.
 
-    The Doc is NOT thread-safe; the receive thread applies remote
-    messages under ``self.lock``, and local edits from other threads
+    The Doc is NOT thread-safe; the receive and ticker threads drive
+    the session under ``self.lock``, and local edits from other threads
     must take the same lock (see ``_demo``)."""
 
-    def __init__(self, ydoc: Y.Doc, sock: socket.socket, awareness=None):
+    def __init__(
+        self, ydoc: Y.Doc, sock: socket.socket, awareness=None,
+        config: SessionConfig | None = None,
+    ):
         super().__init__(ydoc, awareness)
         self._sock = sock
         self._send_lock = threading.Lock()
@@ -52,14 +64,22 @@ class SocketConnector(AbstractConnector):
         # update handler fires while the editor holds self.lock, and
         # blocking in sendall there would deadlock two back-pressured
         # peers whose rx threads both wait on that lock
-        import queue
-
         self._outbox: "queue.Queue[bytes | None]" = queue.Queue()
+        self._transport = CallbackTransport(self._enqueue)
+        self.session = SyncSession(
+            DocSessionHost(ydoc, origin=self),
+            config=config,
+            peer=f"fd{sock.fileno()}",
+        )
         ydoc.on("update", self._on_local_update)
         self._rx = threading.Thread(target=self._recv_loop, daemon=True)
         self._tx = threading.Thread(target=self._send_loop, daemon=True)
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
 
     # -- framing ------------------------------------------------------------
+
+    def _enqueue(self, payload: bytes) -> None:
+        self._outbox.put(bytes(payload))  # never blocks the editor
 
     def _send(self, payload: bytes) -> None:
         with self._send_lock:
@@ -84,19 +104,20 @@ class SocketConnector(AbstractConnector):
     # -- sync flow ----------------------------------------------------------
 
     def connect(self) -> None:
-        """Send sync step 1 and start the reader/writer threads."""
-        enc = Encoder()
-        protocol.write_sync_step1(enc, self.doc)
-        self._outbox.put(enc.to_bytes())
+        """Start the session handshake and the rx/tx/ticker threads."""
+        with self.lock:
+            self.session.connect(self._transport)
         self._rx.start()
         self._tx.start()
+        self._ticker.start()
+        self.on_connect()
 
     def _on_local_update(self, update: bytes, origin, doc) -> None:
         if origin is self or self._closed:
             return  # don't echo remote updates back
-        enc = Encoder()
-        protocol.write_update(enc, update)
-        self._outbox.put(enc.to_bytes())  # never blocks the editor
+        # the editor already holds self.lock (RLock: re-entry is fine)
+        with self.lock:
+            self.session.send_update(update)
 
     def _send_loop(self) -> None:
         try:
@@ -105,45 +126,59 @@ class SocketConnector(AbstractConnector):
                 if payload is None:
                     break
                 self._send(payload)
-        except OSError:
-            pass  # peer vanished: rx loop emits the close event
+        except OSError as e:
+            self.on_error(e)  # peer vanished: rx loop emits the close
 
     def _recv_loop(self) -> None:
+        reason = "eof"
         try:
             while not self._closed:
                 payload = self._recv()
                 if payload is None:
                     break
-                dec = Decoder(payload)
-                enc = Encoder()
-                # replies (our step 2) ride the outbox too; the doc
-                # mutation happens under the shared doc lock
                 with self.lock:
-                    protocol.read_sync_message(dec, enc, self.doc, self)
-                reply = enc.to_bytes()
-                if reply:
-                    self._outbox.put(reply)
-        except (OSError, ValueError):
-            pass  # peer vanished / malformed frame: fall through to close
+                    self._transport.deliver(payload)
+        except (OSError, ValueError) as e:
+            reason = f"error: {type(e).__name__}"
+            self.on_error(e)
         finally:
             self.emit("close", [])
+            self.on_disconnect(reason)
+
+    def _tick_loop(self) -> None:
+        # session time advances on a fixed wall cadence; everything the
+        # tick drives (retransmit backoff, heartbeats, liveness, the
+        # anti-entropy digests) counts in these ticks
+        import time
+
+        while not self._closed:
+            time.sleep(TICK_SECONDS)
+            with self.lock:
+                if self._closed:
+                    break
+                self.session.tick()
 
     def close(self) -> None:
+        if self._closed:
+            return
         self._closed = True
         self.doc.off("update", self._on_local_update)
+        with self.lock:
+            self.session.close()
         self._outbox.put(None)  # unblock the writer thread
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         self._sock.close()
+        self.on_disconnect("closed")
 
 
 def _demo(role: str, port: int) -> None:
     doc = Y.Doc(gc=False)
-    doc.client_id = 1 if role == "serve" else 2
+    doc.client_id = 1 if role == "server" else 2
     text = doc.get_text("text")
-    if role == "serve":
+    if role == "server":
         srv = socket.socket()
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", port))
@@ -166,8 +201,12 @@ def _demo(role: str, port: int) -> None:
     with connector.lock:
         print(f"{role}: {text.to_string()!r}")
         print(f"{role}: sv={Y.encode_state_vector(doc).hex()}")
+        print(f"{role}: session={connector.session.snapshot()}")
     connector.close()
 
 
 if __name__ == "__main__":
+    if len(sys.argv) < 2 or sys.argv[1] not in ("server", "client"):
+        print(f"usage: {sys.argv[0]} server|client [port]")
+        sys.exit(2)
     _demo(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 47800)

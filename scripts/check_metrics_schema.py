@@ -6,8 +6,9 @@ family at construction) plus the process-global registry, extracts the
 ``ytpu_*`` names from the README Observability table, and fails when
 either side has a name the other lacks — so the docs and the exposition
 surface cannot drift apart.  Also cross-checks the resilience/chaos/
-durability/profiling env knobs (``YTPU_CHAOS_*`` / ``YTPU_RESILIENCE_*``
-/ ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` / ``YTPU_PROF_*`` / ``YTPU_SLO_*``)
+durability/profiling/network env knobs (``YTPU_CHAOS_*`` /
+``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` /
+``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*``)
 read by the code against the knobs README documents.  Wired as a tier-1
 check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
 runnable standalone:
@@ -47,7 +48,7 @@ def registered_names() -> set[str]:
 
 
 _KNOB_RE = re.compile(
-    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO)_[A-Z0-9_]+"
+    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET)_[A-Z0-9_]+"
 )
 
 

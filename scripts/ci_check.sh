@@ -11,4 +11,6 @@ echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
 echo "== tier-1 tests (not slow) =="
+# includes the chaos / durability / network marker suites (all
+# deterministic); deselect one with e.g. -m 'not slow and not network'
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

@@ -36,6 +36,7 @@ GROUPS = (
     ("native planner", ("ytpu_native_",)),
     ("provider", ("ytpu_provider_",)),
     ("sync", ("ytpu_sync_",)),
+    ("network (sessions)", ("ytpu_net_",)),
     ("resilience", ("ytpu_resilience_", "ytpu_doc_", "ytpu_dead_letter",
                     "ytpu_dlq_", "ytpu_chaos_")),
     ("durability (WAL)", ("ytpu_wal_",)),
@@ -146,6 +147,16 @@ def demo_snapshot():
     prov.receive_update("room1", encode_state_as_update(d), undoable=True)
     prov.flush()
     prov.undo("room1")
+    # one peer session over an in-memory pipe so the network section
+    # renders live counters (handshake + a delivered update)
+    from yjs_tpu.sync import PipeNetwork
+
+    peer = TpuProvider(1)
+    net = PipeNetwork()
+    t1, t2 = net.pair()
+    prov.session("room0", "demo-peer").connect(t1)
+    peer.session("room0", "demo-host").connect(t2)
+    net.settle((prov.tick_sessions, peer.tick_sessions))
     return prov
 
 

@@ -48,6 +48,20 @@ COLUMNS = (
     ("occup", 6),
 )
 
+# per-peer session rows (rendered as a second table when any provider
+# snapshot carries a "sessions" list — see provider.sessions_snapshot)
+SESSION_COLUMNS = (
+    ("provider", 14),
+    ("room", 10),
+    ("peer", 10),
+    ("state", 12),
+    ("outbox", 7),
+    ("rtx", 6),
+    ("ack age", 8),
+    ("resumes", 8),
+    ("shed", 5),
+)
+
 _STATE_NAMES = {0: "ok", 1: "warning", 2: "page"}
 
 
@@ -99,6 +113,20 @@ def collect_row(
         "quar": int(_gauge(snap, "ytpu_resilience_docs_quarantined")),
         "wal rec": int(_counter_sum(snap, "ytpu_wal_records_appended_total")),
         "occup": f"{_gauge(snap, 'ytpu_prof_slot_occupancy'):.2f}",
+        "sessions": [
+            {
+                "provider": name,
+                "room": str(s.get("guid", "?")),
+                "peer": str(s.get("peer", "?")),
+                "state": str(s.get("state", "?")),
+                "outbox": int(s.get("outbox_depth", 0)),
+                "rtx": int(s.get("retransmits", 0)),
+                "ack age": int(s.get("last_ack_age", 0)),
+                "resumes": int(s.get("resumes", 0)),
+                "shed": int(s.get("shed", 0)),
+            }
+            for s in (snap.get("sessions") or [])
+        ],
         "totals": {"docs_flushed": docs_flushed},
     }
 
@@ -120,6 +148,18 @@ def render(rows: list[dict], interval: float) -> str:
         order = {"ok": 0, "warning": 1, "page": 2}
         if order.get(row["slo"], 0) > order.get(worst, 0):
             worst = row["slo"]
+    sess_rows = [s for row in rows for s in row.get("sessions", [])]
+    if sess_rows:
+        out.append("")
+        out.append(
+            "  ".join(f"{title:>{w}}" for title, w in SESSION_COLUMNS)
+        )
+        for s in sess_rows:
+            out.append(
+                "  ".join(
+                    f"{str(s[title]):>{w}}" for title, w in SESSION_COLUMNS
+                )
+            )
     out.append(f"fleet verdict: {worst}")
     return "\n".join(out) + "\n"
 
@@ -145,23 +185,28 @@ class FileSource:
 
 
 class DemoSource:
-    """Two in-process providers trading sync traffic; every poll applies
-    one fresh edit to each and converges them through the real wire."""
+    """Two in-process providers joined by per-room peer sessions over
+    an in-memory pipe; every poll applies one fresh edit and pumps the
+    wire, so the session table renders live states and ack ages."""
 
     def __init__(self):
         from yjs_tpu.provider import TpuProvider
+        from yjs_tpu.sync import PipeNetwork
 
         self.a = TpuProvider(8)
         self.b = TpuProvider(8)
         self._n = 0
-        # cross-wire the broadcast seams: an update flushed by one
-        # provider is received (and SLO-tracked) by the other
-        self.a.on_update(
-            lambda guid, u: self.b.receive_update(guid, u)
-        )
-        self.b.on_update(
-            lambda guid, u: self.a.receive_update(guid, u)
-        )
+        self.net = PipeNetwork()
+        for k in range(4):
+            t1, t2 = self.net.pair()
+            self.a.session(f"room{k}", "provider-b").connect(t1)
+            self.b.session(f"room{k}", "provider-a").connect(t2)
+
+    def _drive(self) -> None:
+        self.a.flush()
+        self.b.flush()
+        self.a.tick_sessions()
+        self.b.tick_sessions()
 
     def poll(self) -> list[tuple[str, dict]]:
         from yjs_tpu.core import Doc
@@ -172,8 +217,7 @@ class DemoSource:
         d.get_text("text").insert(0, f"edit {self._n} ")
         u = encode_state_as_update(d)
         self.a.receive_update(f"room{self._n % 4}", u)
-        self.a.flush()
-        self.b.flush()
+        self.net.settle((self._drive,))
         return [
             ("provider-a", self.a.metrics_snapshot()),
             ("provider-b", self.b.metrics_snapshot()),

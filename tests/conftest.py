@@ -55,6 +55,13 @@ def pytest_configure(config):
         "markers",
         "durability: write-ahead-log persistence and crash-recovery tests",
     )
+    # "network" tags the session-layer suite (ISSUE 5) — in tier-1 by
+    # default (in-memory pipes, deterministic seeds), deselectable with
+    # -m 'not network'
+    config.addinivalue_line(
+        "markers",
+        "network: peer-session, retransmission, and network-chaos tests",
+    )
 
 
 @pytest.fixture

@@ -26,6 +26,7 @@ fsync tradeoffs.
 from .records import (
     FLAG_V2,
     HEADER_SIZE,
+    KIND_ACK,
     KIND_DLQ,
     KIND_NAMES,
     KIND_RELEASE,
@@ -57,6 +58,7 @@ from .wal import (
 __all__ = [
     "FLAG_V2",
     "HEADER_SIZE",
+    "KIND_ACK",
     "KIND_DLQ",
     "KIND_NAMES",
     "KIND_RELEASE",

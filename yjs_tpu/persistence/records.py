@@ -7,7 +7,7 @@ record format so a single reader serves segments and checkpoints alike:
     segment file header:    b"YTPUWAL1"   (checkpoint: b"YTPUSNP1")
     record header (14 B, little-endian):
         magic        u16    0x7EA1
-        kind         u8     1=update 2=snapshot 3=dlq 4=release
+        kind         u8     1=update 2=snapshot 3=dlq 4=release 5=ack
         flags        u8     bit0 = payload uses the V2 update encoding
         guid_len     u16
         payload_len  u32
@@ -38,11 +38,13 @@ KIND_UPDATE = 1
 KIND_SNAPSHOT = 2
 KIND_DLQ = 3
 KIND_RELEASE = 4
+KIND_ACK = 5
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
     KIND_DLQ: "dlq",
     KIND_RELEASE: "release",
+    KIND_ACK: "ack",
 }
 
 FLAG_V2 = 1

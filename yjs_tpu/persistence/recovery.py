@@ -24,6 +24,7 @@ import time
 from pathlib import Path
 
 from .records import (
+    KIND_ACK,
     KIND_DLQ,
     KIND_RELEASE,
     KIND_SNAPSHOT,
@@ -132,6 +133,7 @@ def replay_wal(
         "dead_lettered": 0,
         "dlq_restored": 0,
         "released": 0,
+        "session_acks": 0,
         "corrupt_records": 0,
         "torn_truncations": 0,
         "duration_s": 0.0,
@@ -235,6 +237,27 @@ def replay_wal(
                 provider._apply_release_record(rec.guid)
                 stats["released"] += 1
                 m.replayed.labels(disposition="released").inc()
+            elif rec.kind == KIND_ACK:
+                # session ack floor (ISSUE 5): the journaled "we hold
+                # peer session <sid> up to <seq>" fact.  Later records
+                # win (floors only advance); the rebuilt provider's
+                # sessions HELLO with these so the surviving peer
+                # resumes retransmission instead of a full resync.
+                try:
+                    ack = json.loads(rec.payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    ack = None
+                hints = getattr(provider, "_recovered_acks", None)
+                if isinstance(ack, dict) and hints is not None:
+                    try:
+                        hints[(rec.guid, str(ack["peer"]))] = (
+                            int(ack["sid"]), int(ack["seq"])
+                        )
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                    else:
+                        stats["session_acks"] += 1
+                        m.replayed.labels(disposition="ack").inc()
     if stats["snapshots_applied"] or stats["records_applied"]:
         # queue_update was called below the provider's dirty-tracking
         # seam; without this, device-backed engines would leave the

@@ -13,6 +13,7 @@ step 1 (state vector) / step 2 (diff update) / incremental updates.
 
 from __future__ import annotations
 
+import json
 import os
 
 from .lib0.decoding import Decoder
@@ -21,6 +22,7 @@ from .lib0 import decoding, encoding
 from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
+    KIND_ACK,
     KIND_RELEASE,
     KIND_UPDATE,
     WalConfig,
@@ -28,6 +30,7 @@ from .persistence import (
     WriteAheadLog,
 )
 from .sync import protocol
+from .sync.session import SessionConfig, SessionMetrics, SyncSession
 from .updates import validate_update
 
 
@@ -38,6 +41,46 @@ class ProviderFullError(ValueError):
     bare ``ValueError("provider is full")`` keep working; new callers
     can catch the typed error and :meth:`TpuProvider.release_doc` a
     cold room to free a slot."""
+
+
+class _ProviderSessionHost:
+    """Session host over one provider room (the shape
+    :class:`yjs_tpu.sync.session.SyncSession` drives): state vectors
+    and diffs are served by the engine flush-first so they reflect
+    pending traffic, and inbound frames route through
+    ``handle_sync_message`` — the validation / WAL / SLO / dead-letter
+    seam a session must not bypass."""
+
+    __slots__ = ("provider", "guid", "peer")
+
+    def __init__(self, provider: "TpuProvider", guid: str, peer: str):
+        self.provider = provider
+        self.guid = guid
+        self.peer = peer
+
+    def state_vector(self) -> bytes:
+        p = self.provider
+        p.flush()
+        return p.engine.encode_state_vector(p.doc_id(self.guid))
+
+    def diff_update(self, sv: bytes | None) -> bytes:
+        return self.provider.encode_state_as_update(self.guid, sv)
+
+    def apply_update(self, update: bytes) -> None:
+        self.provider.receive_update(self.guid, update)
+
+    def handle_frame(self, frame: bytes) -> bytes | None:
+        return self.provider.handle_sync_message(self.guid, frame)
+
+    def dead_letter(self, payload: bytes, reason: str) -> None:
+        p = self.provider
+        p.engine._dead_letter(
+            p.doc_id(self.guid), bytes(payload), False,
+            f"{reason} (peer {self.peer})",
+        )
+
+    def journal_ack(self, sid: int, seq: int) -> None:
+        self.provider.journal_session_ack(self.guid, self.peer, sid, seq)
 
 
 class TpuProvider:
@@ -155,6 +198,15 @@ class TpuProvider:
         )
         # stats dict of the replay that built this provider (recover())
         self.last_recovery: dict | None = None
+        # per-peer session layer (ISSUE 5): sessions keyed by
+        # (room guid, peer name); families register unconditionally so
+        # exposition and the schema checker see the full surface
+        self._session_metrics = SessionMetrics(r)
+        self._sessions: dict[tuple[str, str], SyncSession] = {}
+        self._sessions_bridged = False
+        # (guid, peer) -> (peer sid, recv floor) journaled ack facts
+        # collected by replay_wal; armed onto sessions as resume hints
+        self._recovered_acks: dict[tuple[str, str], tuple[int, int]] = {}
 
     # -- doc management -----------------------------------------------------
 
@@ -504,6 +556,111 @@ class TpuProvider:
         self._m_step2_bytes.inc(sum(len(rep) for rep in replies))
         return replies
 
+    # -- peer sessions (ISSUE 5) --------------------------------------------
+
+    def _ensure_session_bridge(self) -> None:
+        """Lazily register the flush-emitted-update → sessions fan-out
+        (only providers that actually host sessions pay the listener)."""
+        if self._sessions_bridged:
+            return
+        self._sessions_bridged = True
+
+        def bridge(doc, update):
+            g = self._guid_of.get(doc)
+            if g is None:
+                return
+            self.slo.origin(update)
+            for (sg, _peer), sess in list(self._sessions.items()):
+                if sg == g:
+                    sess.send_update(update)
+
+        self.engine.on_update(bridge)
+
+    def session(
+        self, guid: str, peer: str = "peer",
+        config: SessionConfig | None = None,
+    ) -> SyncSession:
+        """Get-or-create the :class:`SyncSession` for (room, peer).
+
+        The session shares the provider's ``ytpu_net_*`` metric
+        families, receives the room's flush-emitted updates, routes
+        inbound frames through :meth:`handle_sync_message`, journals
+        ack floors to the WAL, and — after :meth:`recover` — starts
+        armed with the journaled resume hint so its first HELLO asks
+        the surviving peer for delta catch-up, not a full resync.
+        Attach a transport with ``session.connect(transport)`` and
+        drive :meth:`tick_sessions` at the server's cadence."""
+        key = (guid, str(peer))
+        sess = self._sessions.get(key)
+        if sess is not None and not sess._closed:
+            return sess
+        self._ensure_session_bridge()
+        self.doc_id(guid)  # allocate (or veto: ProviderFullError) now
+        host = _ProviderSessionHost(self, guid, str(peer))
+        sess = SyncSession(
+            host, config=config, metrics=self._session_metrics,
+            peer=str(peer),
+        )
+        hint = self._recovered_acks.get(key)
+        if hint is not None:
+            sess.set_resume_hint(*hint)
+        self._sessions[key] = sess
+        return sess
+
+    def close_session(self, guid: str, peer: str) -> None:
+        sess = self._sessions.pop((guid, str(peer)), None)
+        if sess is not None:
+            sess.close()
+        self._session_metrics.set_state_gauges(self._sessions.values())
+
+    def tick_sessions(self) -> None:
+        """One session-time tick for every peer session (retransmit
+        backoff, heartbeats, liveness, anti-entropy) + gauge refresh."""
+        for sess in list(self._sessions.values()):
+            sess.tick()
+        self._session_metrics.set_state_gauges(self._sessions.values())
+
+    def sessions_snapshot(self) -> list[dict]:
+        """Per-peer session rows (guid, state, outbox depth,
+        retransmits, last-ack age, ...) — the ``ytpu_top`` feed."""
+        rows = []
+        for (guid, _peer), sess in sorted(self._sessions.items()):
+            row = sess.snapshot()
+            row["guid"] = guid
+            rows.append(row)
+        self._session_metrics.set_state_gauges(self._sessions.values())
+        return rows
+
+    def journal_session_ack(
+        self, guid: str, peer: str, sid: int, seq: int
+    ) -> None:
+        """Journal "room ``guid`` holds peer session ``sid`` up to
+        ``seq``" (KIND_ACK).  Recovery replays these into resume hints:
+        a rebuilt provider's sessions resume retransmission from the
+        floor instead of forcing a full resync."""
+        if self.wal is None or not sid:
+            return
+        payload = json.dumps(
+            {"peer": peer, "sid": sid, "seq": seq}
+        ).encode("utf-8")
+        self.wal.append(KIND_ACK, guid, payload)
+
+    def _journal_ack_floors(self) -> None:
+        """Re-append every known ack floor (live sessions win over
+        recovered hints) — called after checkpoint compaction drops the
+        journaled history the floors lived in."""
+        if self.wal is None:
+            return
+        floors = dict(self._recovered_acks)
+        for (guid, peer), sess in self._sessions.items():
+            if sess._peer_sid:
+                floors[(guid, peer)] = (sess._peer_sid, sess._recv_cum)
+        for (guid, peer), (sid, seq) in sorted(floors.items()):
+            payload = json.dumps(
+                {"peer": peer, "sid": sid, "seq": seq}
+            ).encode("utf-8")
+            self.wal.append(KIND_ACK, guid, payload)
+
     # -- state accessors ----------------------------------------------------
 
     def text(self, guid: str) -> str:
@@ -672,6 +829,7 @@ class TpuProvider:
         SLO state under ``"slo"``."""
         snap = self.engine.metrics_snapshot()
         snap["slo"] = self.slo.snapshot()
+        snap["sessions"] = self.sessions_snapshot()
         return snap
 
     def slo_snapshot(self) -> dict:
@@ -757,10 +915,15 @@ class TpuProvider:
         self.flush()
         docs = sorted(self._guid_of)
         snaps = self.engine.encode_states_batched(docs)
-        return self.wal.checkpoint(
+        res = self.wal.checkpoint(
             [(self._guid_of[i], s) for i, s in zip(docs, snaps)],
             self._dump_dlq(),
         )
+        # compaction dropped the segments the session ack floors lived
+        # in: re-journal them so a crash after this checkpoint still
+        # resumes peer retransmission instead of full-resyncing
+        self._journal_ack_floors()
+        return res
 
     def close(self, checkpoint: bool = True) -> None:
         """Orderly shutdown: flush, write a final checkpoint (so restart

@@ -14,9 +14,12 @@ per-doc blast radius (ISSUE 2 tentpole):
 - :mod:`.chaos` — deterministic fault injectors: ``ChaosInjector``
   (corrupt / truncate / duplicate / reorder / drop) for the
   provider/protocol seams, driven by ``YTPU_CHAOS_*`` env knobs and
-  used by the chaos test suite, and ``DiskFaultInjector``
+  used by the chaos test suite, ``DiskFaultInjector``
   (disk_tear / disk_bitflip) for WAL files in the crash-recovery
-  harness (ISSUE 3).
+  harness (ISSUE 3), and ``NetworkFaultInjector``
+  (net_drop / net_delay / net_dup / net_reorder / net_partition,
+  ``YTPU_CHAOS_NET_*`` knobs) for the session transport seam
+  (ISSUE 5).
 
 The engine-side half (transactional per-doc flush isolation, rollback
 via the ``_demote`` replay machinery) lives in
@@ -34,7 +37,13 @@ like the pre-resilience engine), ``YTPU_RESILIENCE_THRESHOLD``
 
 from __future__ import annotations
 
-from .chaos import ChaosConfig, ChaosInjector, DiskFaultInjector  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosInjector,
+    DiskFaultInjector,
+    NetChaosConfig,
+    NetworkFaultInjector,
+)
 from .deadletter import DeadLetter, DeadLetterQueue  # noqa: F401
 from .health import (  # noqa: F401
     DEGRADED,

@@ -191,6 +191,145 @@ class ChaosInjector:
         return out
 
 
+class NetChaosConfig:
+    """Per-fault probabilities + PRNG seed for the TRANSPORT seam
+    (ISSUE 5).  Distinct from :class:`ChaosConfig`: these faults act on
+    whole frames in flight (a lossy datagram link), not on payload
+    bytes — nothing here corrupts content, so the session layer's
+    ack/retransmit + anti-entropy machinery must heal every mix.
+
+    Env knobs (probabilities in [0, 1], default 0 = disabled):
+    ``YTPU_CHAOS_SEED`` plus ``YTPU_CHAOS_NET_DROP``,
+    ``YTPU_CHAOS_NET_DELAY``, ``YTPU_CHAOS_NET_DUP``,
+    ``YTPU_CHAOS_NET_REORDER``, ``YTPU_CHAOS_NET_PARTITION``."""
+
+    __slots__ = ("seed", "drop", "delay", "duplicate", "reorder",
+                 "partition")
+
+    def __init__(
+        self,
+        seed: int = 0,
+        drop: float = 0.0,
+        delay: float = 0.0,
+        duplicate: float = 0.0,
+        reorder: float = 0.0,
+        partition: float = 0.0,
+    ):
+        self.seed = seed
+        self.drop = drop
+        self.delay = delay
+        self.duplicate = duplicate
+        self.reorder = reorder
+        self.partition = partition
+
+    @classmethod
+    def from_env(cls, env=None) -> "NetChaosConfig":
+        env = os.environ if env is None else env
+        try:
+            seed = int(env.get("YTPU_CHAOS_SEED", "0"))
+        except (TypeError, ValueError):
+            seed = 0
+        return cls(
+            seed=seed,
+            drop=_env_float(env, "YTPU_CHAOS_NET_DROP"),
+            delay=_env_float(env, "YTPU_CHAOS_NET_DELAY"),
+            duplicate=_env_float(env, "YTPU_CHAOS_NET_DUP"),
+            reorder=_env_float(env, "YTPU_CHAOS_NET_REORDER"),
+            partition=_env_float(env, "YTPU_CHAOS_NET_PARTITION"),
+        )
+
+    def any_faults(self) -> bool:
+        return any(
+            getattr(self, f) > 0.0
+            for f in ("drop", "delay", "duplicate", "reorder", "partition")
+        )
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class NetworkFaultInjector:
+    """Frame-level fault injection for :class:`yjs_tpu.sync.transport.
+    PipeNetwork` — the transport seam the session layer must survive.
+
+    Three hooks, all driven by one seeded PRNG (same determinism
+    contract as :class:`ChaosInjector`):
+
+    - :meth:`fates` — at enqueue, each frame's delivery plan: a list of
+      pump-round delays (one per delivered copy; ``None`` = dropped
+      copy).  Applies drop, duplicate, and delay.
+    - :meth:`partitioned` — per pump round: while a partition window is
+      open the link is down and everything due that round is lost (the
+      classic net-split; retransmission must heal it).
+    - :meth:`maybe_reorder` — per pump round, maybe shuffle the due
+      batch.
+
+    Faults are counted in the process-global ``ytpu_chaos_faults_total``
+    family (``net_drop``/``net_delay``/``net_dup``/``net_reorder``/
+    ``net_partition``).
+    """
+
+    _NET_FAULTS = ("net_drop", "net_delay", "net_dup", "net_reorder",
+                   "net_partition")
+
+    def __init__(self, config: NetChaosConfig | None = None):
+        self.config = config if config is not None else NetChaosConfig.from_env()
+        self.rng = random.Random(self.config.seed)
+        self.fault_counts: dict[str, int] = {f: 0 for f in self._NET_FAULTS}
+        self._partition_left = 0
+        fam = global_registry().counter(
+            "ytpu_chaos_faults_total",
+            "Faults injected by the chaos harness, by fault kind",
+            labelnames=("fault",),
+        )
+        self._children = {f: fam.labels(fault=f) for f in self._NET_FAULTS}
+
+    def _hit(self, fault: str) -> None:
+        self.fault_counts[fault] += 1
+        self._children[fault].inc()
+
+    def fates(self, frame: bytes) -> list:
+        """Delivery plan for one enqueued frame: delays in pump rounds
+        per copy (``None`` entries are dropped copies)."""
+        cfg, rng = self.config, self.rng
+        if cfg.drop and rng.random() < cfg.drop:
+            self._hit("net_drop")
+            return [None]
+        n_copies = 1
+        if cfg.duplicate and rng.random() < cfg.duplicate:
+            self._hit("net_dup")
+            n_copies = 2
+        out = []
+        for _ in range(n_copies):
+            delay = 0
+            if cfg.delay and rng.random() < cfg.delay:
+                self._hit("net_delay")
+                delay = 1 + rng.randrange(3)
+            out.append(delay)
+        return out
+
+    def partitioned(self) -> bool:
+        """Is the link down this pump round?  Partition windows open
+        with probability ``partition`` and last 1-4 rounds."""
+        if self._partition_left > 0:
+            self._partition_left -= 1
+            self._hit("net_partition")
+            return True
+        cfg = self.config
+        if cfg.partition and self.rng.random() < cfg.partition:
+            self._partition_left = self.rng.randrange(4)
+            self._hit("net_partition")
+            return True
+        return False
+
+    def maybe_reorder(self, batch: list) -> list:
+        if self.config.reorder and self.rng.random() < self.config.reorder:
+            self._hit("net_reorder")
+            batch = list(batch)
+            self.rng.shuffle(batch)
+        return batch
+
+
 class DiskFaultInjector:
     """File-level faults for the WAL crash harness (ISSUE 3).
 

@@ -9,3 +9,22 @@ from .protocol import (  # noqa: F401
     write_sync_step2,
     write_update,
 )
+from .session import (  # noqa: F401
+    CONNECTING,
+    LAGGING,
+    LIVE,
+    MESSAGE_YTPU_SESSION,
+    RECONNECTING,
+    SYNCING,
+    CLOSED,
+    DocSessionHost,
+    SessionConfig,
+    SessionMetrics,
+    SyncSession,
+)
+from .transport import (  # noqa: F401
+    CallbackTransport,
+    PipeNetwork,
+    PipeTransport,
+    Transport,
+)
