@@ -846,6 +846,131 @@ def bench_network(n_ops: int = 200) -> dict:
     }
 
 
+def bench_fleet(n_ops: int = 200) -> dict:
+    """Fleet routing + live-migration cost (ISSUE 6), two parts:
+
+    - **simulated scale**: 100k docs placed onto N simulated shard
+      devices through the bare bounded-load ring (per-shard loads as
+      plain arrays) — placement throughput, docs-per-shard spread, and
+      the reassignment churn of draining one shard (the consistent-hash
+      minimal-movement contract, measured not assumed);
+    - **real migration**: a small live fleet timing ``migrate_doc`` end
+      to end (intent journal + export + apply + release + epoch bump) —
+      migrations/s and the p50/p99 stall a doc sees while moving.
+
+    The block is also written to BENCH_fleet.json.
+    """
+    import gc
+
+    from yjs_tpu.fleet import FleetRouter, HashRing
+
+    n_sim = int(os.environ.get("YTPU_BENCH_FLEET_DOCS", "100000"))
+    n_shards = int(os.environ.get("YTPU_BENCH_FLEET_SHARDS", "8"))
+
+    ring = HashRing(range(n_shards), vnodes=64)
+    cap = max(1, (2 * n_sim) // n_shards)
+    loads = [0] * n_shards
+    owners = [0] * n_sim
+    shed = 0
+    t0 = time.perf_counter()
+    for i in range(n_sim):
+        s, did_shed = ring.place(
+            f"doc-{i}", loads.__getitem__, lambda _s: cap, 1.25
+        )
+        loads[s] += 1
+        owners[i] = s
+        if did_shed:
+            shed += 1
+    place_dt = time.perf_counter() - t0
+    spread = {
+        "min": min(loads),
+        "max": max(loads),
+        "mean": round(n_sim / n_shards, 1),
+        # 1.0 = perfectly even; the bounded-load ceiling caps this at
+        # ~the configured load factor
+        "imbalance": round(max(loads) * n_shards / n_sim, 3),
+    }
+
+    # drain churn: retire one shard and re-place ONLY its docs
+    victim = n_shards - 1
+    ring.remove(victim)
+    to_move = [i for i in range(n_sim) if owners[i] == victim]
+    t1 = time.perf_counter()
+    for i in to_move:
+        s, _ = ring.place(
+            f"doc-{i}", loads.__getitem__, lambda _s: cap, 1.25,
+            exclude={victim},
+        )
+        loads[victim] -= 1
+        loads[s] += 1
+        owners[i] = s
+    drain_dt = time.perf_counter() - t1
+
+    # -- real fleet: live migration latency --------------------------------
+    gc.collect()
+    n_docs = int(os.environ.get("YTPU_BENCH_FLEET_MIG_DOCS", "24"))
+    updates = load_distinct_traces(n_docs, n_ops)
+    fleet = FleetRouter(4, n_docs)
+    for i, u in enumerate(updates):
+        fleet.receive_update(f"room-{i}", u)
+    fleet.flush()
+    # one untimed round trip warms the export/apply compile caches
+    warm_src = fleet.shard_of("room-0")
+    fleet.migrate_doc("room-0", (warm_src + 1) % 4)
+    fleet.migrate_doc("room-0", warm_src)
+    stalls_ms = []
+    t2 = time.perf_counter()
+    for i in range(n_docs):
+        g = f"room-{i}"
+        dst = (fleet.shard_of(g) + 1) % 4
+        m0 = time.perf_counter()
+        fleet.migrate_doc(g, dst)
+        stalls_ms.append((time.perf_counter() - m0) * 1000.0)
+    mig_dt = time.perf_counter() - t2
+    converged = all(
+        fleet.text(f"room-{i}") is not None for i in range(n_docs)
+    )
+    stalls_ms.sort()
+
+    def pct(p):
+        return round(stalls_ms[min(len(stalls_ms) - 1,
+                                   int(p * len(stalls_ms)))], 3)
+
+    out = {
+        "sim": {
+            "n_docs": n_sim,
+            "n_shards": n_shards,
+            "placements_per_sec": (
+                round(n_sim / place_dt, 1) if place_dt else 0.0
+            ),
+            "docs_per_shard": spread,
+            "shed_placements": shed,
+            "drain_moved_docs": len(to_move),
+            "drain_churn_fraction": round(len(to_move) / n_sim, 4),
+            "drain_replace_per_sec": (
+                round(len(to_move) / drain_dt, 1) if drain_dt else 0.0
+            ),
+        },
+        "migration": {
+            "n_docs": n_docs,
+            "n_shards": 4,
+            "trace_ops": n_ops,
+            "migrations_per_sec": (
+                round(n_docs / mig_dt, 1) if mig_dt else 0.0
+            ),
+            "stall_ms_p50": pct(0.50),
+            "stall_ms_p99": pct(0.99),
+            "converged": converged,
+        },
+    }
+    try:
+        with open("BENCH_fleet.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -899,6 +1024,8 @@ def main():
     durability = bench_durability()
     time.sleep(3)
     network = bench_network()
+    time.sleep(3)
+    fleet = bench_fleet()
     time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
@@ -961,6 +1088,7 @@ def main():
             "resilience": resilience,
             "durability": durability,
             "network": network,
+            "fleet": fleet,
         },
     }
     if sweep is not None:

@@ -6,9 +6,9 @@ family at construction) plus the process-global registry, extracts the
 ``ytpu_*`` names from the README Observability table, and fails when
 either side has a name the other lacks — so the docs and the exposition
 surface cannot drift apart.  Also cross-checks the resilience/chaos/
-durability/profiling/network env knobs (``YTPU_CHAOS_*`` /
+durability/profiling/network/fleet env knobs (``YTPU_CHAOS_*`` /
 ``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` /
-``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*``)
+``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*`` / ``YTPU_FLEET_*``)
 read by the code against the knobs README documents.  Wired as a tier-1
 check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
 runnable standalone:
@@ -38,17 +38,21 @@ def documented_names(readme_text: str) -> set[str]:
 
 
 def registered_names() -> set[str]:
+    from yjs_tpu.fleet import FleetRouter
     from yjs_tpu.obs import global_registry
     from yjs_tpu.provider import TpuProvider
 
     prov = TpuProvider(1)
+    # the smallest possible fleet registers every ytpu_fleet_* family
+    # on the global registry (ISSUE 6)
+    FleetRouter(1, 1)
     return set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )
 
 
 _KNOB_RE = re.compile(
-    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET)_[A-Z0-9_]+"
+    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET)_[A-Z0-9_]+"
 )
 
 

@@ -10,7 +10,13 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== fleet smoke (marker: fleet) =="
+# the sharded-fleet suite (ISSUE 6) runs first as a fast standalone
+# smoke: routing, migration, and recovery regressions surface before
+# the full tier sinks time into everything else
+python -m pytest tests/ -q -m 'fleet and not slow' -p no:cacheprovider
+
 echo "== tier-1 tests (not slow) =="
-# includes the chaos / durability / network marker suites (all
+# includes the chaos / durability / network / fleet marker suites (all
 # deterministic); deselect one with e.g. -m 'not slow and not network'
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

@@ -48,6 +48,22 @@ COLUMNS = (
     ("occup", 6),
 )
 
+# per-shard fleet rows (rendered when a snapshot carries a "fleet"
+# block — see FleetRouter.fleet_snapshot / metrics_snapshot)
+FLEET_COLUMNS = (
+    ("provider", 14),
+    ("shard", 6),
+    ("docs", 6),
+    ("cap", 5),
+    ("occup", 6),
+    ("state", 8),
+    ("dlq", 5),
+    ("sess", 5),
+    ("migr", 5),
+    ("in", 4),
+    ("out", 4),
+)
+
 # per-peer session rows (rendered as a second table when any provider
 # snapshot carries a "sessions" list — see provider.sessions_snapshot)
 SESSION_COLUMNS = (
@@ -127,6 +143,39 @@ def collect_row(
             }
             for s in (snap.get("sessions") or [])
         ],
+        "fleet": [
+            {
+                "provider": name,
+                "shard": int(sh.get("shard", -1)),
+                "docs": int(sh.get("docs", 0)),
+                "cap": int(sh.get("capacity", 0)),
+                "occup": f"{float(sh.get('occupancy', 0)):.2f}",
+                "state": str(sh.get("state", "?")),
+                "dlq": int(sh.get("dlq", 0)),
+                "sess": int(sh.get("sessions", 0)),
+                "migr": int(sh.get("migrating", 0)),
+                "in": int(sh.get("mig_in", 0)),
+                "out": int(sh.get("mig_out", 0)),
+            }
+            for sh in (snap.get("fleet") or {}).get("shards", [])
+        ],
+        "fleet_head": (
+            {
+                "epoch": int((snap.get("fleet") or {}).get("epoch", 0)),
+                "docs": int((snap.get("fleet") or {}).get("docs", 0)),
+                "capacity": int(
+                    (snap.get("fleet") or {}).get("capacity", 0)
+                ),
+                "live": int(
+                    (snap.get("fleet") or {}).get("live_shards", 0)
+                ),
+                "migrating": int(
+                    (snap.get("fleet") or {}).get("migrations_active", 0)
+                ),
+            }
+            if snap.get("fleet")
+            else None
+        ),
         "totals": {"docs_flushed": docs_flushed},
     }
 
@@ -148,6 +197,28 @@ def render(rows: list[dict], interval: float) -> str:
         order = {"ok": 0, "warning": 1, "page": 2}
         if order.get(row["slo"], 0) > order.get(worst, 0):
             worst = row["slo"]
+    fleet_rows = [s for row in rows for s in row.get("fleet", [])]
+    if fleet_rows:
+        heads = [
+            r["fleet_head"] for r in rows if r.get("fleet_head")
+        ]
+        out.append("")
+        if heads:
+            h = heads[0]
+            out.append(
+                f"fleet: epoch={h['epoch']}  docs={h['docs']}/"
+                f"{h['capacity']}  live_shards={h['live']}  "
+                f"migrating={h['migrating']}"
+            )
+        out.append(
+            "  ".join(f"{title:>{w}}" for title, w in FLEET_COLUMNS)
+        )
+        for s in fleet_rows:
+            out.append(
+                "  ".join(
+                    f"{str(s[title]):>{w}}" for title, w in FLEET_COLUMNS
+                )
+            )
     sess_rows = [s for row in rows for s in row.get("sessions", [])]
     if sess_rows:
         out.append("")

@@ -62,6 +62,14 @@ def pytest_configure(config):
         "markers",
         "network: peer-session, retransmission, and network-chaos tests",
     )
+    # "fleet" tags the sharded-provider-fleet suite (ISSUE 6) — in
+    # tier-1 by default (deterministic, tmp-dir WALs), deselectable
+    # with -m 'not fleet'; ci_check.sh also runs it standalone first
+    config.addinivalue_line(
+        "markers",
+        "fleet: doc-sharded fleet routing, migration, and rebalancing "
+        "tests",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,475 @@
+"""Fleet suite (ISSUE 6): the doc-sharded provider fleet — bounded-load
+consistent-hash placement, the versioned routing table, the provider
+facade, live migration with double delivery, drain/scale-out, the churn
+rebalancer, per-shard mesh placement, and the fleet session fan-out.
+
+Everything is deterministic (blake2b placement, tick-time sessions,
+seeded edits).  In tier-1; ``scripts/ci_check.sh`` also runs it first as
+a standalone smoke via the ``fleet`` marker.
+"""
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.fleet import (
+    FleetConfig,
+    FleetFullError,
+    FleetRouter,
+    HashRing,
+    RoutingTable,
+    stable_hash,
+)
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.sync.session import SessionConfig
+from yjs_tpu.sync.transport import PipeNetwork
+from yjs_tpu.updates import encode_state_as_update, encode_state_vector
+
+pytestmark = pytest.mark.fleet
+
+
+def quiet_config(**kw):
+    base = dict(
+        heartbeat=0, liveness=0, antientropy=0, hello_timeout=0,
+        retry_base=4, retry_jitter=0.0, seed=1,
+    )
+    base.update(kw)
+    return SessionConfig(**base)
+
+
+def update_for(text, client_id=99):
+    d = Y.Doc(gc=False)
+    d.client_id = client_id
+    d.get_text("text").insert(0, text)
+    return encode_state_as_update(d)
+
+
+def drive(fleet, peer):
+    def fn():
+        fleet.flush()
+        peer.flush()
+        fleet.tick_sessions()
+        peer.tick_sessions()
+
+    return fn
+
+
+# -- hash ring ---------------------------------------------------------------
+
+
+def test_stable_hash_is_process_stable_and_64_bit():
+    # blake2b, not hash(): the value below must never change across
+    # processes or releases — routing tables depend on it
+    assert stable_hash("room-0") == stable_hash("room-0")
+    assert stable_hash("room-0") != stable_hash("room-1")
+    for k in ("", "a", "room/x", "☃"):
+        assert 0 <= stable_hash(k) < (1 << 64)
+
+
+def test_ring_owner_deterministic_across_instances():
+    a = HashRing(range(8), vnodes=32)
+    b = HashRing(range(8), vnodes=32)
+    guids = [f"doc-{i}" for i in range(500)]
+    assert [a.owner(g) for g in guids] == [b.owner(g) for g in guids]
+    # membership means every shard actually gets traffic
+    assert len({a.owner(g) for g in guids}) == 8
+
+
+def test_ring_minimal_movement_on_membership_change():
+    before = HashRing(range(8), vnodes=64)
+    guids = [f"doc-{i}" for i in range(2000)]
+    owners = {g: before.owner(g) for g in guids}
+    before.add(8)  # scale out 8 -> 9
+    moved = sum(1 for g in guids if before.owner(g) != owners[g])
+    # classic consistent hashing: ~1/9 of docs re-home, never a reshuffle
+    assert 0 < moved < len(guids) * 0.25
+    # every doc that moved, moved TO the new shard
+    assert all(
+        before.owner(g) == 8 for g in guids if before.owner(g) != owners[g]
+    )
+
+
+def test_bounded_load_sheds_off_hot_shard():
+    ring = HashRing(range(4), vnodes=64)
+    loads = {s: 0 for s in range(4)}
+    caps = {s: 1000 for s in range(4)}
+    placed = {}
+    for i in range(400):
+        g = f"doc-{i}"
+        s, _shed = ring.place(g, loads.get, caps.get, 1.25)
+        loads[s] += 1
+        placed[g] = s
+    # the ceiling held: no shard exceeds ceil(1.25 * total / N) by more
+    # than the +1 headroom the formula grants per placement
+    assert max(loads.values()) <= (1.25 * (400 + 1) / 4) + 1
+    # and at least one doc was diverted off its natural owner
+    assert any(ring.owner(g) != placed[g] for g in placed)
+
+
+def test_place_fallback_and_fleet_full():
+    ring = HashRing(range(2), vnodes=16)
+    # both shards over the bound but one has a hard slot free: the
+    # least-loaded one takes it rather than failing
+    s, shed = ring.place("doc", {0: 10, 1: 9}.get, {0: 10, 1: 10}.get, 0.5)
+    assert s == 1 and shed
+    with pytest.raises(FleetFullError):
+        ring.place("doc", {0: 10, 1: 10}.get, {0: 10, 1: 10}.get, 1.25)
+
+
+def test_routing_table_versioned():
+    t = RoutingTable()
+    assert t.epoch == 0 and t.lookup("a") is None
+    t.assign("a", 2)
+    assert t.epoch == 0  # bare assign does not version
+    t.assign("b", 2, bump=True)
+    assert t.epoch == 1
+    assert t.docs_on(2) == ["a", "b"]
+    t.unassign("a", bump=True)
+    assert t.epoch == 2 and t.lookup("a") is None
+    snap = t.snapshot()
+    assert snap["n_docs"] == 1 and snap["per_shard"] == {2: 1}
+
+
+# -- fleet facade ------------------------------------------------------------
+
+
+def test_fleet_admits_past_single_shard_capacity():
+    fleet = FleetRouter(3, 2, backend="cpu")
+    for i in range(5):  # one shard caps at 2; the fleet holds 6
+        fleet.receive_update(f"doc-{i}", update_for(f"text {i}"))
+    fleet.flush()
+    for i in range(5):
+        assert fleet.text(f"doc-{i}") == f"text {i}"
+        owner = fleet.owner_of(f"doc-{i}")
+        assert owner is not None
+        assert fleet.shards[owner].has_doc(f"doc-{i}")
+    assert fleet.doc_count == 5 and fleet.capacity == 6
+    fleet.receive_update("doc-5", update_for("last slot"))
+    with pytest.raises(FleetFullError):
+        fleet.receive_update("doc-6", update_for("no room"))
+
+
+def test_fleet_speaks_the_provider_surface():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    fleet.receive_update("room", update_for("surface"))
+    ref = TpuProvider(1, backend="cpu")
+    ref.receive_update("room", update_for("surface"))
+    assert fleet.text("room") == ref.text("room") == "surface"
+    assert fleet.state_vector("room") == ref.state_vector("room")
+    assert Y.merge_updates([fleet.encode_state_as_update("room")]) == (
+        Y.merge_updates([ref.encode_state_as_update("room")])
+    )
+    assert isinstance(fleet.sync_step1("room"), bytes)
+    h = fleet.health()
+    assert len(h["shards"]) == 2 and h["fleet"]["docs"] == 1
+    snap = fleet.fleet_snapshot()
+    assert snap["n_shards"] == snap["live_shards"] == 2
+    assert snap["capacity"] == 4 and snap["migrations_active"] == 0
+    row = snap["shards"][0]
+    for key in ("shard", "docs", "capacity", "occupancy", "state",
+                "dlq", "sessions", "migrating", "mig_in", "mig_out"):
+        assert key in row
+
+
+# -- live migration ----------------------------------------------------------
+
+
+def test_migrate_doc_preserves_bytes_frees_slot_bumps_epoch():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    fleet.receive_update("room", update_for("move me"))
+    src = fleet.shard_of("room")
+    dst = 1 - src
+    before = Y.merge_updates([fleet.encode_state_as_update("room")])
+    epoch0 = fleet.table.epoch
+    fleet.migrate_doc("room", dst)
+    assert fleet.owner_of("room") == dst
+    assert fleet.table.epoch == epoch0 + 1
+    assert not fleet.shards[src].has_doc("room")  # slot freed for reuse
+    assert fleet.shards[dst].has_doc("room")
+    assert fleet.text("room") == "move me"
+    assert Y.merge_updates([fleet.encode_state_as_update("room")]) == before
+
+
+def test_double_delivery_window_loses_no_inflight_update():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    d = Y.Doc(gc=False)
+    d.client_id = 7
+    d.get_text("text").insert(0, "base")
+    fleet.receive_update("room", encode_state_as_update(d))
+    src = fleet.shard_of("room")
+    dst = 1 - src
+    fleet.begin_migration("room", dst)
+    assert fleet.fleet_snapshot()["migrations_active"] == 1
+    # an edit lands INSIDE the window: both shards must journal it
+    sv = encode_state_vector(d)
+    d.get_text("text").insert(0, "tail-")
+    fleet.receive_update("room", encode_state_as_update(d, sv))
+    fleet.complete_migration("room")
+    assert fleet.owner_of("room") == dst
+    assert fleet.text("room") == "tail-base"
+
+
+def test_migration_misuse_is_typed():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    fleet.receive_update("room", update_for("x"))
+    src = fleet.shard_of("room")
+    with pytest.raises(ValueError):
+        fleet.migrate_doc("room", src)  # already lives there
+    with pytest.raises(ValueError):
+        fleet.migrate_doc("room", 99)  # not a shard
+    with pytest.raises(RuntimeError):
+        fleet.complete_migration("room")  # no window open
+    fleet.begin_migration("room", 1 - src)
+    with pytest.raises(RuntimeError):
+        fleet.begin_migration("room", 1 - src)  # already migrating
+    fleet.complete_migration("room")
+
+
+def test_drain_shard_retires_and_excludes_from_placement():
+    fleet = FleetRouter(3, 4, backend="cpu")
+    for i in range(6):
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    texts = {f"doc-{i}": f"t{i}" for i in range(6)}
+    victim = fleet.shard_of("doc-0")
+    on_victim = len(fleet.shards[victim].guids())
+    moved = fleet.drain_shard(victim)
+    assert moved == on_victim >= 1
+    assert not fleet.shards[victim].guids()
+    assert victim not in fleet.live_shards
+    assert fleet.fleet_snapshot()["shards"][victim]["state"] == "retired"
+    for g, t in texts.items():
+        assert fleet.text(g) == t
+        assert fleet.owner_of(g) != victim
+    # future placements never propose the retired shard
+    for i in range(6, 8):  # 2 live shards x 4 slots hold 8 docs total
+        fleet.receive_update(f"doc-{i}", update_for("new"))
+        assert fleet.owner_of(f"doc-{i}") != victim
+    assert fleet.drain_shard(victim) == 0  # idempotent
+
+
+def test_drain_fails_fast_when_rest_of_fleet_lacks_slots():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    for i in range(4):  # full fleet: nowhere to move anything
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    victim = fleet.shard_of("doc-0")
+    snapshot_before = fleet.fleet_snapshot()
+    with pytest.raises(FleetFullError, match="add_shard"):
+        fleet.drain_shard(victim)
+    # the veto left the fleet untouched — no half-drained wedge
+    assert victim in fleet.live_shards
+    assert fleet.fleet_snapshot() == snapshot_before
+
+
+def test_add_shard_grows_capacity_and_joins_ring():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    for i in range(4):
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    with pytest.raises(FleetFullError):
+        fleet.receive_update("doc-4", update_for("full"))
+    epoch0 = fleet.table.epoch
+    k = fleet.add_shard()
+    assert k == 2 and fleet.capacity == 6
+    assert fleet.table.epoch == epoch0 + 1
+    fleet.receive_update("doc-4", update_for("fits now"))
+    assert fleet.owner_of("doc-4") == k  # only shard with room
+    assert fleet.text("doc-4") == "fits now"
+
+
+# -- rebalancer --------------------------------------------------------------
+
+
+def hot_fleet(high=0.75, target=0.5, batch=8):
+    cfg = FleetConfig(
+        rebalance_high=high, rebalance_target=target, rebalance_batch=batch,
+    )
+    fleet = FleetRouter(2, 4, backend="cpu", config=cfg)
+    for i in range(4):
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    # herd everything onto shard 0 so it sits at occupancy 1.0
+    for i in range(4):
+        if fleet.shard_of(f"doc-{i}") != 0:
+            fleet.migrate_doc(f"doc-{i}", 0)
+    assert fleet.shards[0].occupancy == 1.0
+    return fleet
+
+
+def test_rebalancer_sheds_hot_shard_to_target():
+    fleet = hot_fleet()
+    decisions = fleet.tick()
+    moves = [d for d in decisions if d["action"] == "move"]
+    assert moves and all(d["src"] == 0 for d in moves)
+    # shed down to target occupancy (0.5 * 4 slots = 2 docs), texts kept
+    assert len(fleet.shards[0].guids()) == 2
+    for i in range(4):
+        assert fleet.text(f"doc-{i}") == f"t{i}"
+    # a balanced fleet's next tick is a no-op
+    assert fleet.tick() == []
+
+
+def test_rebalancer_moves_coldest_docs_first():
+    fleet = hot_fleet()
+    fleet.session("doc-0", "peer", quiet_config())  # doc-0 is now warm
+    moves = [d for d in fleet.rebalancer.plan() if d["action"] == "move"]
+    assert [d["guid"] for d in moves] == ["doc-1", "doc-2"]  # sessionless
+
+
+def test_rebalancer_records_stuck_when_nowhere_to_move():
+    cfg = FleetConfig(
+        rebalance_high=0.75, rebalance_target=0.5, rebalance_batch=4,
+    )
+    fleet = FleetRouter(2, 2, backend="cpu", config=cfg)
+    for i in range(4):  # both shards at 1.0: no destination qualifies
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    decisions = fleet.tick()
+    assert decisions and all(d["action"] == "stuck" for d in decisions)
+    assert fleet.doc_count == 4  # nothing thrashed
+
+
+# -- mesh placement ----------------------------------------------------------
+
+
+def test_shard_meshes_partition_devices_contiguously():
+    from yjs_tpu.parallel import shard_meshes
+
+    meshes = shard_meshes(4, devices_per_shard=2)  # conftest: 8 cpu devs
+    assert len(meshes) == 4
+    seen = []
+    for m in meshes:
+        assert m is not None and m.devices.size == 2
+        seen.extend(d.id for d in m.devices.flat)
+    assert seen == sorted(seen) and len(set(seen)) == 8  # disjoint, dealt
+
+    # more shards than devices: the degraded mode is explicit Nones
+    assert shard_meshes(16) == [None] * 16
+    with pytest.raises(ValueError):
+        shard_meshes(0)
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_fleet_metric_families_registered_globally():
+    from yjs_tpu.obs import global_registry
+
+    FleetRouter(1, 1, backend="cpu")
+    names = set(global_registry().names())
+    for fam in (
+        "ytpu_fleet_shards",
+        "ytpu_fleet_docs",
+        "ytpu_fleet_shard_docs",
+        "ytpu_fleet_shard_occupancy",
+        "ytpu_fleet_routing_epoch",
+        "ytpu_fleet_placements_total",
+        "ytpu_fleet_migrations_total",
+        "ytpu_fleet_migration_seconds",
+        "ytpu_fleet_double_delivered_total",
+        "ytpu_fleet_rebalance_decisions_total",
+    ):
+        assert fam in names, fam
+
+
+def test_fleet_gauges_track_state():
+    from yjs_tpu.obs import global_registry
+
+    fleet = FleetRouter(2, 2, backend="cpu")
+    fleet.receive_update("room", update_for("x"))
+    fleet._refresh_gauges()
+    r = global_registry()
+    assert r.get("ytpu_fleet_shards").value == 2
+    assert r.get("ytpu_fleet_docs").value == 1
+    assert r.get("ytpu_fleet_routing_epoch").value == fleet.table.epoch
+    occ = {
+        labels["shard"]: series.value
+        for labels, series in r.get("ytpu_fleet_shard_occupancy").samples()
+        if labels["shard"] in ("0", "1")
+    }
+    owner = str(fleet.shard_of("room"))
+    assert occ[owner] == 0.5
+
+
+def test_ytpu_top_renders_fleet_table():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_top_fleet_test",
+        pathlib.Path(__file__).resolve().parent.parent
+        / "scripts" / "ytpu_top.py",
+    )
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+
+    fleet = FleetRouter(3, 2, backend="cpu")
+    for i in range(4):
+        fleet.receive_update(f"doc-{i}", update_for(f"t{i}"))
+    fleet.session("doc-0", "peer", quiet_config())
+    row = top.collect_row("fleet-a", fleet.metrics_snapshot(), None, 1.0)
+    assert row["fleet"] and len(row["fleet"]) == 3
+    frame = top.render([row], 1.0)
+    assert "fleet:" in frame and "occup" in frame and "shard" in frame
+
+
+# -- sessions over the fleet -------------------------------------------------
+
+
+def test_fleet_sessions_fan_out_across_shards():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    peer = TpuProvider(4, backend="cpu")
+    net = PipeNetwork()
+    rooms = [f"doc-{i}" for i in range(3)]
+    for g in rooms:
+        tf, tp = net.pair(f"f-{g}", f"p-{g}")
+        fleet.session(g, "peer", quiet_config()).connect(tf)
+        peer.session(g, "fleet", quiet_config()).connect(tp)
+    net.settle((drive(fleet, peer),))
+    # the rooms span both shards yet one facade serves them all
+    assert len({fleet.shard_of(g) for g in rooms}) == 2
+    for g in rooms:
+        peer.receive_update(g, update_for(f"from peer {g}"))
+    net.settle((drive(fleet, peer),))
+    for g in rooms:
+        assert fleet.text(g) == f"from peer {g}"
+    # and the reverse direction: fleet-side traffic reaches the peer
+    fleet.receive_update(rooms[0], update_for("from fleet", client_id=5))
+    net.settle((drive(fleet, peer),))
+    assert "from fleet" in peer.text(rooms[0])
+    rows = fleet.sessions_snapshot()
+    assert len(rows) == 3
+    assert all(row["shard"] == fleet.shard_of(row["guid"]) for row in rows)
+
+
+def test_fleet_session_survives_live_migration():
+    fleet = FleetRouter(2, 2, backend="cpu")
+    peer = TpuProvider(1, backend="cpu")
+    net = PipeNetwork()
+    tf, tp = net.pair()
+    sf = fleet.session("room", "peer", quiet_config(antientropy=2))
+    sp = peer.session("room", "fleet", quiet_config(antientropy=2))
+    sf.connect(tf)
+    sp.connect(tp)
+    net.settle((drive(fleet, peer),))
+    peer.receive_update("room", update_for("pre-move"))
+    net.settle((drive(fleet, peer),))
+    assert fleet.text("room") == "pre-move"
+    src = fleet.shard_of("room")
+    fleet.migrate_doc("room", 1 - src)
+    # the session re-homed in place: no reconnect, epoch current, and
+    # rehome() forced a digest so divergence heals immediately
+    assert sf.routing_epoch == fleet.table.epoch
+    assert not sf._closed and sf.state == "live"
+    net.settle((drive(fleet, peer),), max_rounds=60, idle_rounds=3)
+    peer.receive_update("room", update_for("post-move", client_id=3))
+    net.settle((drive(fleet, peer),), max_rounds=60, idle_rounds=3)
+    assert "post-move" in fleet.text("room")
+    assert fleet.text("room") == peer.text("room")
+    assert sf.n_full_resyncs == 1 and sp.n_full_resyncs == 1
+
+
+def test_fleet_session_admission_is_atomic():
+    fleet = FleetRouter(1, 1, backend="cpu")
+    fleet.receive_update("a", update_for("occupies the only slot"))
+    with pytest.raises(ValueError):  # ProviderFullError
+        fleet.session("b", "peer", quiet_config())
+    assert ("b", "peer") not in fleet._sessions  # veto left no entry
+    fleet.shards[0].release_doc("a")
+    sess = fleet.session("b", "peer", quiet_config())  # now admits
+    assert fleet._sessions[("b", "peer")] is sess

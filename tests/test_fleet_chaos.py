@@ -1,0 +1,217 @@
+"""Fleet chaos suite (ISSUE 6 acceptance): a sharded fleet under doc
+churn is killed mid-migration (every WAL abandoned, double-delivery
+window open, an in-flight edit in the air) and recovered from the
+per-shard WAL root.  Pins: byte-identical convergence against
+uninterrupted CPU reference docs, every doc owned by EXACTLY one shard,
+and the recovered fleet keeps taking traffic.
+
+Deterministic end to end (seeded edits, blake2b placement, simulated
+crashes via ``WriteAheadLog.abandon``).  In tier-1 under the ``fleet``
++ ``chaos`` + ``durability`` markers.
+"""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.fleet import FleetConfig, FleetRouter
+from yjs_tpu.persistence import WalConfig
+from yjs_tpu.updates import encode_state_as_update, encode_state_vector
+
+pytestmark = [
+    pytest.mark.fleet, pytest.mark.chaos, pytest.mark.durability,
+]
+
+SMALL = WalConfig(segment_bytes=256, fsync="never")
+
+
+def seeded_rooms(seed, n_rooms=8, n_ops=12):
+    """room -> (reference Doc, incremental update stream), seeded."""
+    out = {}
+    for j in range(n_rooms):
+        gen = random.Random(seed * 1000 + j)
+        d = Y.Doc(gc=False)
+        d.client_id = 100 + j
+        updates = []
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        t = d.get_text("text")
+        for _ in range(n_ops):
+            if len(t) and gen.random() < 0.3:
+                t.delete(gen.randrange(len(t)), 1)
+            else:
+                t.insert(gen.randrange(len(t) + 1), gen.choice("abcdef "))
+        out[f"room-{j}"] = (d, updates)
+    return out
+
+
+def edit(doc, text, pos=0):
+    """One more reference edit, returned as its incremental update."""
+    sv = encode_state_vector(doc)
+    doc.get_text("text").insert(pos, text)
+    return encode_state_as_update(doc, sv)
+
+
+def canonical(fleet, guid):
+    return Y.merge_updates([fleet.encode_state_as_update(guid)])
+
+
+def canonical_doc(doc):
+    return Y.merge_updates([encode_state_as_update(doc)])
+
+
+def slot_owners(fleet):
+    """guid -> [shards actually holding an engine slot for it]."""
+    out = {}
+    for k, p in enumerate(fleet.shards):
+        for g in p.guids():
+            out.setdefault(g, []).append(k)
+    return out
+
+
+def crash(fleet):
+    """Kill every shard: no close, no checkpoint, handles dropped."""
+    for p in fleet.shards:
+        p.wal.abandon()
+
+
+def test_kill_fleet_mid_migration_recovers_to_single_owner(tmp_path):
+    rooms = seeded_rooms(seed=6)
+    cfg = FleetConfig(
+        rebalance_high=0.75, rebalance_target=0.5, rebalance_batch=4,
+    )
+    fleet = FleetRouter(
+        3, 4, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+        config=cfg,
+    )
+    # churn: 8 rooms of seeded traffic — past any single shard's 4
+    # slots, so admission only works because placement sharded
+    for g, (_d, ups) in rooms.items():
+        for u in ups:
+            fleet.receive_update(g, u)
+    fleet.flush()
+    # a rebalance pass (shards that filled to the high watermark shed;
+    # every move is itself an intent+release-journaled migration)
+    fleet.tick()
+
+    # open a migration window, then lose power with it OPEN and an
+    # in-flight edit double-delivered but never released
+    guid = "room-0"
+    src = fleet.shard_of(guid)
+    dst = next(
+        k for k in fleet.live_shards
+        if k != src and fleet._load(k) < fleet._capacity(k)
+    )
+    fleet.begin_migration(guid, dst)
+    fleet.receive_update(guid, edit(rooms[guid][0], "tail!"))
+    fleet.flush()
+    crash(fleet)
+    del fleet
+
+    rec = FleetRouter.recover(
+        tmp_path, docs_per_shard=4, backend="cpu", wal_config=SMALL,
+    )
+    # the open intent resolved by completing the handoff (the
+    # destination had journaled the doc's state)
+    res = rec.last_recovery["resolution"]
+    assert res["completed"] == 1 and res["deduped"] == 0
+    assert rec.owner_of(guid) == dst
+
+    # exactly one shard holds each doc, and the routing table agrees
+    own = slot_owners(rec)
+    assert sorted(own) == sorted(rooms)
+    for g, holders in own.items():
+        assert holders == [rec.owner_of(g)]
+
+    # byte-identical reconvergence — including the in-window tail edit
+    for g, (d, _ups) in rooms.items():
+        assert rec.text(g) == str(d.get_text("text"))
+        assert canonical(rec, g) == canonical_doc(d)
+
+    # the recovered fleet is live: more traffic converges
+    for g in ("room-0", "room-5"):
+        rec.receive_update(g, edit(rooms[g][0], "after "))
+        assert rec.text(g) == str(rooms[g][0].get_text("text"))
+
+
+def test_intent_only_crash_aborts_to_source(tmp_path):
+    """Crash between the intent append and the state transfer: the
+    destination never admitted the doc, so recovery aborts the
+    migration and the source keeps sole ownership."""
+    fleet = FleetRouter(
+        2, 2, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+    )
+    d = Y.Doc(gc=False)
+    d.client_id = 1
+    d.get_text("text").insert(0, "stay")
+    fleet.receive_update("room", encode_state_as_update(d))
+    fleet.flush()
+    src = fleet.shard_of("room")
+    fleet.shards[src].journal_migration("room", 1 - src, fleet.table.epoch)
+    crash(fleet)
+    del fleet
+
+    rec = FleetRouter.recover(
+        tmp_path, docs_per_shard=2, backend="cpu", wal_config=SMALL,
+    )
+    res = rec.last_recovery["resolution"]
+    assert res["aborted"] == 1 and res["completed"] == 0
+    assert rec.owner_of("room") == src
+    assert slot_owners(rec)["room"] == [src]
+    assert rec.text("room") == "stay"
+
+
+def test_release_marker_closes_the_window_durably(tmp_path):
+    """Crash AFTER complete_migration: the source's release record is
+    the durable handoff marker, so recovery resurrects nothing on the
+    source and resolves no intents."""
+    fleet = FleetRouter(
+        2, 2, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+    )
+    d = Y.Doc(gc=False)
+    d.client_id = 2
+    d.get_text("text").insert(0, "moved")
+    fleet.receive_update("room", encode_state_as_update(d))
+    src = fleet.shard_of("room")
+    fleet.migrate_doc("room", 1 - src)
+    crash(fleet)
+    del fleet
+
+    rec = FleetRouter.recover(
+        tmp_path, docs_per_shard=2, backend="cpu", wal_config=SMALL,
+    )
+    res = rec.last_recovery["resolution"]
+    assert res == {"completed": 0, "aborted": 0, "deduped": 0}
+    assert rec.owner_of("room") == 1 - src
+    assert slot_owners(rec)["room"] == [1 - src]
+    assert rec.text("room") == "moved"
+
+
+def test_checkpoint_then_crash_keeps_open_window_recoverable(tmp_path):
+    """Compaction drops the segment the intent lived in; the fleet
+    checkpoint re-journals open intents, so a crash AFTER a checkpoint
+    taken mid-window still resolves to exactly one owner."""
+    fleet = FleetRouter(
+        2, 2, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+    )
+    d = Y.Doc(gc=False)
+    d.client_id = 3
+    d.get_text("text").insert(0, "compact me")
+    fleet.receive_update("room", encode_state_as_update(d))
+    src = fleet.shard_of("room")
+    dst = 1 - src
+    fleet.begin_migration("room", dst)
+    fleet.checkpoint()
+    fleet.receive_update("room", edit(d, "late "))  # still double-delivers
+    fleet.flush()
+    crash(fleet)
+    del fleet
+
+    rec = FleetRouter.recover(
+        tmp_path, docs_per_shard=2, backend="cpu", wal_config=SMALL,
+    )
+    assert rec.last_recovery["resolution"]["completed"] == 1
+    assert rec.owner_of("room") == dst
+    assert slot_owners(rec)["room"] == [dst]
+    assert rec.text("room") == "late compact me"
+    assert canonical(rec, "room") == canonical_doc(d)

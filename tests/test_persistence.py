@@ -342,6 +342,43 @@ def test_dlq_survives_checkpoint_and_replays(tmp_path):
 # -- slot lifecycle ------------------------------------------------------
 
 
+def test_recovery_overflow_dead_letters_instead_of_dropping(tmp_path):
+    # regression (ISSUE 6 satellite): replay used to DISCARD a doc's
+    # records silently when the recovered provider was smaller than the
+    # journaled fleet — durably-written state vanished.  Overflowed
+    # records must ride the DLQ with their guid in the reason (so an
+    # operator or the fleet rebalancer can re-route them) and count on
+    # ytpu_wal_recovery_overflow_total.
+    streams = phased_streams(
+        seed=33, rooms=("alpha", "beta", "gamma"), phases=(10,)
+    )
+    prov = TpuProvider(3, backend="cpu", wal_dir=tmp_path, wal_config=SMALL)
+    for room, (p1,) in streams.items():
+        for u in p1:
+            prov.receive_update(room, u)
+    prov.flush()
+    prov.wal.abandon()  # crash
+
+    rec = TpuProvider.recover(tmp_path, n_docs=2, backend="cpu")
+    stats = rec.last_recovery
+    assert stats["overflowed"] >= 1
+    admitted = [r for r in streams if rec.has_doc(r)]
+    assert len(admitted) == 2  # first-come admission filled both slots
+    (evicted,) = set(streams) - set(admitted)
+    letters = [
+        e for e in rec.dead_letters()
+        if e["reason"].startswith("wal-overflow:")
+    ]
+    assert len(letters) == stats["overflowed"]
+    assert all(repr(evicted) in e["reason"] for e in letters)
+    # the new counter moved in lockstep with the stats
+    overflow = rec.engine.obs.registry.get(
+        "ytpu_wal_recovery_overflow_total"
+    )
+    assert overflow.value == stats["overflowed"]
+    assert stats["dead_lettered"] >= stats["overflowed"]
+
+
 def test_full_release_reuse_and_eviction_counter(tmp_path):
     streams = phased_streams(seed=99, phases=(15,))
     prov = TpuProvider(2, backend="cpu", wal_dir=tmp_path, wal_config=SMALL)

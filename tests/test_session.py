@@ -554,6 +554,65 @@ def test_wal_journals_acks_and_recovery_resumes(tmp_path):
     assert s2.n_full_resyncs == 1
 
 
+def test_session_admission_veto_leaves_no_half_registration():
+    # regression (ISSUE 6 satellite): session() used to register the
+    # flush bridge and could leave a half-registered peer behind when
+    # doc_id vetoed with ProviderFullError — the carcass was then
+    # ticked and snapshotted forever
+    from yjs_tpu.provider import ProviderFullError
+
+    pa = TpuProvider(1, backend="cpu")
+    d = Y.Doc(gc=False)
+    d.get_text("text").insert(0, "occupies the only slot")
+    pa.receive_update("a", encode_state_as_update(d))
+    with pytest.raises(ProviderFullError):
+        pa.session("b", "peer", quiet_config())
+    assert ("b", "peer") not in pa._sessions
+    assert pa.sessions_snapshot() == []
+    assert not pa._sessions_bridged  # the veto registered no bridge
+    # admission works once a slot frees up — nothing stale in the way
+    pa.release_doc("a")
+    sess = pa.session("b", "peer", quiet_config())
+    assert pa._sessions[("b", "peer")] is sess and not sess._closed
+
+
+def test_release_doc_under_live_session_reconverges_without_resync():
+    # ISSUE 6 satellite: evicting a room (release_doc) while a peer
+    # session holds it must not wedge the session — the next inbound
+    # delta re-admits the room into a fresh slot and the anti-entropy
+    # loop heals the evicted history, with NO second full resync
+    cfg = quiet_config(antientropy=2)
+    pa = TpuProvider(2, backend="cpu")
+    pb = TpuProvider(2, backend="cpu")
+    net = PipeNetwork()
+    ta, tb = net.pair()
+    sa = pa.session("room", "pb", cfg)
+    sb = pb.session("room", "pa", cfg)
+    sa.connect(ta)
+    sb.connect(tb)
+    net.settle((drive(pa, pb),))
+    d = Y.Doc(gc=False)
+    d.client_id = 11
+    d.get_text("text").insert(0, "kept")
+    pb.receive_update("room", encode_state_as_update(d))
+    net.settle((drive(pa, pb),))
+    assert pa.text("room") == "kept"
+
+    pa.release_doc("room")  # evict while both sessions are live
+    sv = encode_state_vector(d)
+    d.get_text("text").insert(0, "next ")
+    pb.receive_update("room", encode_state_as_update(d, sv))
+    net.settle((drive(pa, pb),), max_rounds=120, idle_rounds=5)
+    assert pa.text("room") == pb.text("room") == "next kept"
+    # byte-identical stores after the repair
+    assert Y.merge_updates([pa.encode_state_as_update("room")]) == (
+        Y.merge_updates([pb.encode_state_as_update("room")])
+    )
+    # the handshake's full resync stayed the only one
+    assert sa.n_full_resyncs == 1 and sb.n_full_resyncs == 1
+    assert sa.state == sb.state == "live"
+
+
 def test_checkpoint_preserves_ack_floors(tmp_path):
     cfg = quiet_config()
     p1 = TpuProvider(2, backend="cpu", wal_dir=str(tmp_path))

@@ -1,0 +1,36 @@
+"""Doc-sharded provider fleet (ISSUE 6).
+
+One :class:`TpuProvider` caps the deployment at single-device slot
+capacity.  :class:`FleetRouter` puts N provider shards behind the same
+facade: bounded-load consistent-hash placement
+(:class:`HashRing`), a versioned :class:`RoutingTable`, cross-shard
+session fan-out, live doc migration over the WAL's
+intent/release records, and an occupancy-driven :class:`Rebalancer`.
+Crash recovery (:meth:`FleetRouter.recover`) replays every shard's WAL
+and resolves mid-migration crashes to exactly one owner.
+
+Knobs: ``YTPU_FLEET_VNODES``, ``YTPU_FLEET_LOAD_FACTOR``,
+``YTPU_FLEET_REBALANCE_HIGH``, ``YTPU_FLEET_REBALANCE_TARGET``,
+``YTPU_FLEET_REBALANCE_BATCH``.  Metrics: the ``ytpu_fleet_*``
+families (README "Fleet").
+"""
+
+from .hashring import (
+    FleetFullError,
+    HashRing,
+    RoutingTable,
+    stable_hash,
+)
+from .rebalance import Rebalancer
+from .router import FleetConfig, FleetMetrics, FleetRouter
+
+__all__ = [
+    "FleetConfig",
+    "FleetFullError",
+    "FleetMetrics",
+    "FleetRouter",
+    "HashRing",
+    "Rebalancer",
+    "RoutingTable",
+    "stable_hash",
+]

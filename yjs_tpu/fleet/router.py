@@ -1,0 +1,811 @@
+"""FleetRouter: N TpuProvider shards behind one provider facade (ISSUE 6).
+
+One provider caps the deployment at single-device slot capacity
+(``ProviderFullError``).  The fleet is the architectural unlock the
+ROADMAP names: docs are placed onto shards by the bounded-load
+consistent-hash ring (:mod:`yjs_tpu.fleet.hashring`), each shard runs
+its own :class:`~yjs_tpu.provider.TpuProvider` — optionally on its own
+device mesh from :func:`yjs_tpu.parallel.shard_meshes` — and the router
+speaks the same surface a single provider does (``receive_update`` /
+``handle_sync_message`` / ``session`` / ``text`` / ``checkpoint``), so
+callers scale out by swapping the constructor.
+
+**Live migration** rides the seams earlier PRs built, in an order that
+makes a crash at ANY point recoverable to exactly one owner:
+
+1. the source journals a ``KIND_MIGRATE`` intent (crash here: the
+   destination never saw the doc → recovery aborts, source keeps it);
+2. the source's full state is exported and applied to the destination,
+   which journals it as ordinary updates (crash here: both WALs hold the
+   doc + a pending intent → recovery completes the handoff, transferring
+   the source's final state so no tail update is lost);
+3. the *double-delivery window* opens: in-flight updates and session
+   frames are delivered to BOTH shards — the CRDT's idempotent,
+   commutative merge dedupes, so nothing is dropped or reordered;
+4. ``release_doc()`` on the source journals the release (the durable
+   "handoff complete" marker), its final export is re-applied to the
+   destination, the routing table bumps its epoch, and live sessions
+   ``rehome()`` — an immediate anti-entropy digest repairs anything that
+   raced the window.
+
+The :class:`~yjs_tpu.fleet.rebalance.Rebalancer` ticks on shard
+occupancy to migrate docs off shards approaching full and to drain a
+shard for removal.  Knobs: ``YTPU_FLEET_VNODES``,
+``YTPU_FLEET_LOAD_FACTOR``, ``YTPU_FLEET_REBALANCE_HIGH``,
+``YTPU_FLEET_REBALANCE_TARGET``, ``YTPU_FLEET_REBALANCE_BATCH``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+from ..obs import global_registry
+from ..provider import TpuProvider
+from ..sync.session import SessionConfig, SessionMetrics, SyncSession
+from .hashring import (
+    FleetFullError,
+    HashRing,
+    RoutingTable,
+    _env_float,
+    _env_int,
+)
+from .rebalance import Rebalancer
+
+__all__ = ["FleetConfig", "FleetMetrics", "FleetRouter", "FleetFullError"]
+
+
+class FleetConfig:
+    """Resolved fleet knobs (constructor args beat ``YTPU_FLEET_*`` env
+    beats defaults, same precedence as SessionConfig/WalConfig)."""
+
+    __slots__ = (
+        "vnodes", "load_factor", "rebalance_high", "rebalance_target",
+        "rebalance_batch",
+    )
+
+    def __init__(
+        self,
+        vnodes: int | None = None,
+        load_factor: float | None = None,
+        rebalance_high: float | None = None,
+        rebalance_target: float | None = None,
+        rebalance_batch: int | None = None,
+    ):
+        def pick(v, env, default, conv):
+            return v if v is not None else conv(env, default)
+
+        self.vnodes = pick(vnodes, "YTPU_FLEET_VNODES", 64, _env_int)
+        self.load_factor = pick(
+            load_factor, "YTPU_FLEET_LOAD_FACTOR", 1.25, _env_float
+        )
+        self.rebalance_high = pick(
+            rebalance_high, "YTPU_FLEET_REBALANCE_HIGH", 0.85, _env_float
+        )
+        self.rebalance_target = pick(
+            rebalance_target, "YTPU_FLEET_REBALANCE_TARGET", 0.6, _env_float
+        )
+        self.rebalance_batch = pick(
+            rebalance_batch, "YTPU_FLEET_REBALANCE_BATCH", 4, _env_int
+        )
+
+
+class FleetMetrics:
+    """The ``ytpu_fleet_*`` instrument bundle.
+
+    Registered on the process-global registry by default: provider
+    exposition already merges the global registry, so every shard's
+    ``metrics_text()`` carries the fleet families without extra wiring
+    (and re-registration is a cheap name-dedup no-op)."""
+
+    def __init__(self, registry=None):
+        r = registry if registry is not None else global_registry()
+        self.registry = r
+        self.shards = r.gauge(
+            "ytpu_fleet_shards",
+            "Live (non-retired) shards in the fleet",
+        )
+        self.docs = r.gauge(
+            "ytpu_fleet_docs",
+            "Docs currently admitted across all shards",
+        )
+        self.shard_docs = r.gauge(
+            "ytpu_fleet_shard_docs",
+            "Docs admitted on one shard",
+            labelnames=("shard",),
+        )
+        self.shard_occupancy = r.gauge(
+            "ytpu_fleet_shard_occupancy",
+            "Admitted docs / slot capacity of one shard (1.0 = next "
+            "admission raises ProviderFullError)",
+            labelnames=("shard",),
+        )
+        self.epoch = r.gauge(
+            "ytpu_fleet_routing_epoch",
+            "Routing-table version; bumps on every ownership or "
+            "membership change",
+        )
+        self.placements = r.counter(
+            "ytpu_fleet_placements_total",
+            "First-touch doc placements, by kind (ring = natural owner, "
+            "shed = bounded-load diverted off a hot shard)",
+            labelnames=("kind",),
+        )
+        self.migrations = r.counter(
+            "ytpu_fleet_migrations_total",
+            "Completed doc migrations, by reason (manual / rebalance / "
+            "drain / recovery-complete / recovery-abort / "
+            "recovery-dedupe)",
+            labelnames=("reason",),
+        )
+        self.migration_seconds = r.histogram(
+            "ytpu_fleet_migration_seconds",
+            "Wall time of one live doc migration (intent + export + "
+            "apply + release)",
+            unit="s",
+        )
+        self.double_delivered = r.counter(
+            "ytpu_fleet_double_delivered_total",
+            "Updates/frames delivered to both shards inside a "
+            "migration's double-delivery window (deduped by CRDT "
+            "idempotence)",
+        )
+        self.rebalance = r.counter(
+            "ytpu_fleet_rebalance_decisions_total",
+            "Rebalancer tick decisions, by action (move / stuck)",
+            labelnames=("action",),
+        )
+
+
+class _FleetSessionHost:
+    """Session host that resolves the OWNING shard per call, so a live
+    :class:`SyncSession` rides a migration without reconnecting: the
+    facade re-points, the seq spaces survive, and frames inside the
+    double-delivery window reach both shards."""
+
+    __slots__ = ("fleet", "guid", "peer")
+
+    def __init__(self, fleet: "FleetRouter", guid: str, peer: str):
+        self.fleet = fleet
+        self.guid = guid
+        self.peer = peer
+
+    def _prov(self) -> TpuProvider:
+        return self.fleet.provider_for(self.guid)
+
+    def state_vector(self) -> bytes:
+        p = self._prov()
+        p.flush()
+        return p.engine.encode_state_vector(p.doc_id(self.guid))
+
+    def diff_update(self, sv: bytes | None) -> bytes:
+        return self._prov().encode_state_as_update(self.guid, sv)
+
+    def apply_update(self, update: bytes) -> None:
+        self.fleet.receive_update(self.guid, update)
+
+    def handle_frame(self, frame: bytes) -> bytes | None:
+        return self.fleet._handle_frame_routed(self.guid, frame)
+
+    def dead_letter(self, payload: bytes, reason: str) -> None:
+        p = self._prov()
+        p.engine._dead_letter(
+            p.doc_id(self.guid), bytes(payload), False,
+            f"{reason} (peer {self.peer})",
+        )
+
+    def journal_ack(self, sid: int, seq: int) -> None:
+        self._prov().journal_session_ack(self.guid, self.peer, sid, seq)
+
+
+class FleetRouter:
+    """Doc-sharded provider fleet behind a single provider facade."""
+
+    def __init__(
+        self,
+        n_shards: int | None = None,
+        docs_per_shard: int | None = None,
+        root_name: str = "text",
+        gc: bool = False,
+        backend: str = "auto",
+        wal_dir=None,
+        wal_config=None,
+        meshes=None,
+        config: FleetConfig | None = None,
+        registry=None,
+        providers: list[TpuProvider] | None = None,
+    ):
+        self.config = config if config is not None else FleetConfig()
+        self._root_name = root_name
+        self._gc = gc
+        self._backend = backend
+        self._wal_config = wal_config
+        if wal_dir is None:
+            wal_dir = os.environ.get("YTPU_WAL_DIR")
+        self.wal_root = Path(wal_dir) if wal_dir else None
+
+        if providers is not None:
+            if n_shards is not None and n_shards != len(providers):
+                raise ValueError("n_shards conflicts with providers list")
+            self.shards = list(providers)
+            self._docs_per_shard = docs_per_shard or max(
+                (p.engine.n_docs for p in self.shards), default=1
+            )
+        else:
+            if n_shards is None or n_shards < 1:
+                raise ValueError(f"need n_shards >= 1, got {n_shards}")
+            if docs_per_shard is None or docs_per_shard < 1:
+                raise ValueError(
+                    f"need docs_per_shard >= 1, got {docs_per_shard}"
+                )
+            self._docs_per_shard = docs_per_shard
+            self.shards = [
+                TpuProvider(
+                    docs_per_shard,
+                    root_name=root_name,
+                    mesh=meshes[k] if meshes else None,
+                    gc=gc,
+                    backend=backend,
+                    # "" (not None) when fleet-level journaling is off:
+                    # None would make every shard fall back to
+                    # YTPU_WAL_DIR and share one directory
+                    wal_dir=self._shard_wal_dir(k),
+                    wal_config=wal_config,
+                )
+                for k in range(n_shards)
+            ]
+
+        self.ring = HashRing(
+            range(len(self.shards)), vnodes=self.config.vnodes
+        )
+        self.table = RoutingTable()
+        self.metrics = FleetMetrics(registry)
+        self._session_metrics = SessionMetrics(self.metrics.registry)
+        self._sessions: dict[tuple[str, str], SyncSession] = {}
+        self._update_listeners: list = []
+        # guid -> {"src", "dst", "reason", "t0"} while a migration's
+        # double-delivery window is open
+        self._migrating: dict[str, dict] = {}
+        # shards drained out of placement (still indexable: shard ids
+        # are positional and must stay stable)
+        self._retired: set[int] = set()
+        # per-shard migration traffic for the ytpu_top fleet table
+        self._mig_in: dict[int, int] = {}
+        self._mig_out: dict[int, int] = {}
+        # stats of the replay that built this fleet (recover())
+        self.last_recovery: dict | None = None
+        for k, prov in enumerate(self.shards):
+            prov.shard_id = k
+            self._attach_bridge(k, prov)
+        self.rebalancer = Rebalancer(self)
+        self._refresh_gauges()
+
+    # -- construction helpers ------------------------------------------------
+
+    def _shard_wal_dir(self, k: int) -> str:
+        return str(self.wal_root / f"shard-{k:03d}") if self.wal_root else ""
+
+    def _attach_bridge(self, k: int, prov: TpuProvider) -> None:
+        """Fan this shard's flush-emitted updates out to fleet sessions
+        and listeners.  Inside a doc's double-delivery window the
+        DESTINATION's emissions are suppressed: the source is still the
+        owner of record, and forwarding both would send every peer each
+        delta twice (harmless to the CRDT, wasteful on the wire)."""
+
+        def bridge(guid, update, _k=k):
+            mig = self._migrating.get(guid)
+            if mig is not None and mig["dst"] == _k:
+                return
+            for (g, _peer), sess in list(self._sessions.items()):
+                if g == guid:
+                    sess.send_update(update)
+            for cb in self._update_listeners:
+                cb(guid, update)
+
+        prov.on_update(bridge)
+
+    # -- routing -------------------------------------------------------------
+
+    def shard_of(self, guid: str) -> int:
+        """The owning shard id, placing the doc on first touch."""
+        mig = self._migrating.get(guid)
+        if mig is not None:
+            return mig["src"]
+        s = self.table.lookup(guid)
+        if s is not None:
+            return s
+        return self._place(guid)
+
+    def owner_of(self, guid: str) -> int | None:
+        """Current owner per the routing table; None if never placed.
+        No placement side effect (assertions and dashboards)."""
+        mig = self._migrating.get(guid)
+        if mig is not None:
+            return mig["src"]
+        return self.table.lookup(guid)
+
+    def provider_for(self, guid: str) -> TpuProvider:
+        return self.shards[self.shard_of(guid)]
+
+    def _load(self, s: int) -> int:
+        return len(self.shards[s]._guids)
+
+    def _capacity(self, s: int) -> int:
+        return self.shards[s].engine.n_docs
+
+    def _place(self, guid: str) -> int:
+        try:
+            s, shed = self.ring.place(
+                guid,
+                self._load,
+                self._capacity,
+                self.config.load_factor,
+                exclude=self._retired,
+            )
+        except FleetFullError:
+            self.metrics.placements.labels(kind="full").inc()
+            raise
+        self.table.assign(guid, s)
+        self.metrics.placements.labels(
+            kind="shed" if shed else "ring"
+        ).inc()
+        return s
+
+    # -- provider facade -----------------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    @property
+    def live_shards(self) -> list[int]:
+        return [
+            k for k in range(len(self.shards)) if k not in self._retired
+        ]
+
+    @property
+    def doc_count(self) -> int:
+        return sum(len(p._guids) for p in self.shards)
+
+    @property
+    def capacity(self) -> int:
+        return sum(
+            self._capacity(k) for k in self.live_shards
+        )
+
+    def receive_update(
+        self, guid: str, update: bytes, v2: bool = False,
+        undoable: bool = False,
+    ) -> bool:
+        """Queue one room update on its owning shard.  Inside a
+        migration window the update is double-delivered (source AND
+        destination journal + integrate it); the CRDT merge is
+        idempotent, so the duplicate is free and the handoff can never
+        drop an in-flight edit."""
+        mig = self._migrating.get(guid)
+        accepted = self.shards[self.shard_of(guid)].receive_update(
+            guid, update, v2=v2, undoable=undoable
+        )
+        if mig is not None:
+            self.shards[mig["dst"]].receive_update(guid, update, v2=v2)
+            self.metrics.double_delivered.inc()
+        return accepted
+
+    def _handle_frame_routed(self, guid: str, frame: bytes):
+        mig = self._migrating.get(guid)
+        reply = self.shards[self.shard_of(guid)].handle_sync_message(
+            guid, frame
+        )
+        if mig is not None:
+            # the destination sees the same frame (updates journal on
+            # its WAL; read frames produce a reply we discard)
+            self.shards[mig["dst"]].handle_sync_message(guid, frame)
+            self.metrics.double_delivered.inc()
+        return reply
+
+    def handle_sync_message(self, guid: str, message: bytes):
+        return self._handle_frame_routed(guid, message)
+
+    def sync_step1(self, guid: str) -> bytes:
+        return self.provider_for(guid).sync_step1(guid)
+
+    def text(self, guid: str) -> str:
+        return self.provider_for(guid).text(guid)
+
+    def state_vector(self, guid: str) -> dict[int, int]:
+        return self.provider_for(guid).state_vector(guid)
+
+    def encode_state_as_update(
+        self, guid: str, target_sv: bytes | None = None
+    ) -> bytes:
+        return self.provider_for(guid).encode_state_as_update(
+            guid, target_sv
+        )
+
+    def flush(self) -> None:
+        for k in self.live_shards:
+            self.shards[k].flush()
+
+    def health(self) -> dict:
+        return {
+            "shards": [p.health() for p in self.shards],
+            "fleet": self.fleet_snapshot(),
+        }
+
+    def dead_letters(self, guid: str | None = None) -> list[dict]:
+        if guid is not None:
+            return self.provider_for(guid).dead_letters(guid)
+        out = []
+        for p in self.shards:
+            out.extend(p.dead_letters())
+        return out
+
+    def checkpoint(self) -> list[dict | None]:
+        """Checkpoint every shard's WAL, then re-journal any still-open
+        migration intents (compaction drops the segments they lived in;
+        a crash after the checkpoint must still see the window)."""
+        out = [p.checkpoint() for p in self.shards]
+        for guid, mig in sorted(self._migrating.items()):
+            self.shards[mig["src"]].journal_migration(
+                guid, mig["dst"], self.table.epoch
+            )
+        return out
+
+    def close(self, checkpoint: bool = True) -> None:
+        for p in self.shards:
+            p.close(checkpoint=checkpoint)
+
+    # -- sessions ------------------------------------------------------------
+
+    def session(
+        self, guid: str, peer: str = "peer",
+        config: SessionConfig | None = None,
+    ) -> SyncSession:
+        """Get-or-create the fleet-level peer session for (room, peer).
+        Same contract as ``TpuProvider.session`` — admission atomic
+        with registration — but the host re-resolves the owning shard
+        per call, so the session survives live migration."""
+        key = (guid, str(peer))
+        sess = self._sessions.get(key)
+        if sess is not None:
+            if not sess._closed:
+                return sess
+            del self._sessions[key]
+        # place + admit first: a veto must leave no registry entry
+        prov = self.provider_for(guid)
+        prov.doc_id(guid)
+        host = _FleetSessionHost(self, guid, str(peer))
+        sess = SyncSession(
+            host, config=config, metrics=self._session_metrics,
+            peer=str(peer),
+        )
+        sess.routing_epoch = self.table.epoch
+        self._sessions[key] = sess
+        return sess
+
+    def close_session(self, guid: str, peer: str) -> None:
+        sess = self._sessions.pop((guid, str(peer)), None)
+        if sess is not None:
+            sess.close()
+        self._session_metrics.set_state_gauges(self._sessions.values())
+
+    def tick_sessions(self) -> None:
+        for sess in list(self._sessions.values()):
+            sess.tick()
+        self._session_metrics.set_state_gauges(self._sessions.values())
+
+    def sessions_snapshot(self) -> list[dict]:
+        rows = []
+        for (guid, _peer), sess in sorted(self._sessions.items()):
+            row = sess.snapshot()
+            row["guid"] = guid
+            row["shard"] = self.owner_of(guid)
+            rows.append(row)
+        self._session_metrics.set_state_gauges(self._sessions.values())
+        return rows
+
+    def on_update(self, callback) -> None:
+        """Register ``callback(guid, update_bytes)`` across the whole
+        fleet (the per-shard bridges fan into it)."""
+        self._update_listeners.append(callback)
+
+    # -- live migration ------------------------------------------------------
+
+    def begin_migration(
+        self, guid: str, dst: int, reason: str = "manual"
+    ) -> None:
+        """Open the double-delivery window: journal the intent on the
+        source, seed the destination with the source's full state.
+        From here until :meth:`complete_migration`, updates and session
+        frames for the doc reach BOTH shards."""
+        if guid in self._migrating:
+            raise RuntimeError(f"{guid!r} is already migrating")
+        src = self.shard_of(guid)
+        if dst == src:
+            raise ValueError(f"{guid!r} already lives on shard {dst}")
+        if not (0 <= dst < len(self.shards)) or dst in self._retired:
+            raise ValueError(f"shard {dst} is not a live destination")
+        src_p, dst_p = self.shards[src], self.shards[dst]
+        src_p.doc_id(guid)  # KeyError-grade misuse surfaces as admission
+        t0 = time.perf_counter()
+        # intent FIRST: recovery treats "intent without release" as the
+        # open window and resolves by whether dst journaled the doc.  If
+        # the seed transfer below vetoes (destination full), the stale
+        # intent is harmless — dst never admitted the doc, so recovery
+        # aborts to the source.
+        src_p.journal_migration(guid, dst, self.table.epoch)
+        src_p.flush()
+        state = src_p.encode_state_as_update(guid)
+        dst_p.receive_update(guid, state)
+        self._migrating[guid] = {
+            "src": src, "dst": dst, "reason": reason, "t0": t0,
+        }
+
+    def complete_migration(self, guid: str) -> None:
+        """Close the window: release on the source (journals the
+        durable handoff marker + frees the slot), re-apply the final
+        export to the destination (idempotent), bump the routing epoch,
+        re-home live sessions."""
+        mig = self._migrating.get(guid)
+        if mig is None:
+            raise RuntimeError(f"{guid!r} is not migrating")
+        src, dst = mig["src"], mig["dst"]
+        final = self.shards[src].release_doc(guid)
+        self.shards[dst].receive_update(guid, final)
+        del self._migrating[guid]
+        self.table.assign(guid, dst)
+        epoch = self.table.bump()
+        self._mig_out[src] = self._mig_out.get(src, 0) + 1
+        self._mig_in[dst] = self._mig_in.get(dst, 0) + 1
+        self.metrics.migrations.labels(reason=mig["reason"]).inc()
+        self.metrics.migration_seconds.observe(
+            time.perf_counter() - mig["t0"]
+        )
+        self.metrics.epoch.set(epoch)
+        for (g, _peer), sess in sorted(self._sessions.items()):
+            if g == guid:
+                sess.rehome(epoch)
+
+    def migrate_doc(
+        self, guid: str, dst: int, reason: str = "manual"
+    ) -> None:
+        """One-shot live migration (begin + complete)."""
+        self.begin_migration(guid, dst, reason=reason)
+        self.complete_migration(guid)
+
+    def drain_shard(self, shard: int) -> int:
+        """Migrate every doc off ``shard`` and retire it from placement
+        (scale-in / maintenance).  Returns docs moved.  The shard id
+        stays valid — ids are positional — but the ring stops proposing
+        it and the rebalancer stops reading it."""
+        if not (0 <= shard < len(self.shards)):
+            raise ValueError(f"unknown shard {shard}")
+        if shard in self._retired:
+            return 0
+        # fail BEFORE retiring anything: a drain that would wedge
+        # mid-way (no free slots for the remainder) must not leave the
+        # fleet half-mutated
+        free_elsewhere = sum(
+            self._capacity(k) - self._load(k)
+            for k in self.live_shards
+            if k != shard
+        )
+        need = len(self.shards[shard]._guids)
+        if need > free_elsewhere:
+            raise FleetFullError(
+                f"cannot drain shard {shard}: {need} docs to move but "
+                f"only {free_elsewhere} free slots elsewhere — "
+                "add_shard() first"
+            )
+        self.ring.remove(shard)
+        self._retired.add(shard)
+        moved = 0
+        for guid in self.shards[shard].guids():
+            if guid in self._migrating:
+                continue
+            dst, _shed = self.ring.place(
+                guid, self._load, self._capacity,
+                self.config.load_factor, exclude=self._retired,
+            )
+            self.migrate_doc(guid, dst, reason="drain")
+            moved += 1
+        self.table.bump()
+        self._refresh_gauges()
+        return moved
+
+    def add_shard(self, docs: int | None = None, mesh=None) -> int:
+        """Scale out: append a fresh shard, join it to the ring.  Only
+        ~1/N of FUTURE placements land on it by consistent hashing; the
+        rebalancer migrates existing load over as occupancy demands."""
+        k = len(self.shards)
+        prov = TpuProvider(
+            docs or self._docs_per_shard,
+            root_name=self._root_name,
+            mesh=mesh,
+            gc=self._gc,
+            backend=self._backend,
+            wal_dir=self._shard_wal_dir(k),
+            wal_config=self._wal_config,
+        )
+        prov.shard_id = k
+        self.shards.append(prov)
+        self._attach_bridge(k, prov)
+        self.ring.add(k)
+        self.table.bump()
+        self._refresh_gauges()
+        return k
+
+    # -- ticking + introspection --------------------------------------------
+
+    def tick(self) -> list[dict]:
+        """One fleet tick: session time on every fleet session, then a
+        rebalancer pass.  Returns the rebalance decisions."""
+        self.tick_sessions()
+        decisions = self.rebalancer.tick()
+        self._refresh_gauges()
+        return decisions
+
+    def _refresh_gauges(self) -> None:
+        m = self.metrics
+        m.shards.set(len(self.live_shards))
+        m.docs.set(self.doc_count)
+        m.epoch.set(self.table.epoch)
+        for k, p in enumerate(self.shards):
+            lab = str(k)
+            m.shard_docs.labels(shard=lab).set(len(p._guids))
+            m.shard_occupancy.labels(shard=lab).set(round(p.occupancy, 6))
+
+    def fleet_snapshot(self) -> dict:
+        """JSON-able fleet state — the ``ytpu_top`` fleet-table feed."""
+        self._refresh_gauges()
+        rows = []
+        migrating_by_shard: dict[int, int] = {}
+        for mig in self._migrating.values():
+            for s in (mig["src"], mig["dst"]):
+                migrating_by_shard[s] = migrating_by_shard.get(s, 0) + 1
+        for k, p in enumerate(self.shards):
+            rows.append({
+                "shard": k,
+                "docs": len(p._guids),
+                "capacity": p.engine.n_docs,
+                "occupancy": round(p.occupancy, 4),
+                "state": "retired" if k in self._retired else "live",
+                "dlq": len(p.engine.dead_letters),
+                "sessions": sum(
+                    1 for (g, _pr) in self._sessions
+                    if self.owner_of(g) == k
+                ),
+                "migrating": migrating_by_shard.get(k, 0),
+                "mig_in": self._mig_in.get(k, 0),
+                "mig_out": self._mig_out.get(k, 0),
+            })
+        return {
+            "epoch": self.table.epoch,
+            "n_shards": len(self.shards),
+            "live_shards": len(self.live_shards),
+            "docs": self.doc_count,
+            "capacity": self.capacity,
+            "migrations_active": len(self._migrating),
+            "shards": rows,
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """Merged per-shard snapshots + the fleet table (file mode for
+        ``ytpu_top``: any shard snapshot already carries the global
+        ``ytpu_fleet_*`` families; this adds the structured rows)."""
+        snap = self.shards[0].metrics_snapshot() if self.shards else {}
+        snap = dict(snap)
+        snap["fleet"] = self.fleet_snapshot()
+        snap["sessions"] = self.sessions_snapshot()
+        return snap
+
+    # -- recovery ------------------------------------------------------------
+
+    @classmethod
+    def recover(
+        cls,
+        wal_root,
+        docs_per_shard: int | None = None,
+        root_name: str = "text",
+        gc: bool = False,
+        backend: str = "auto",
+        wal_config=None,
+        meshes=None,
+        config: FleetConfig | None = None,
+        registry=None,
+    ) -> "FleetRouter":
+        """Rebuild a fleet from a crashed predecessor's WAL root
+        (``shard-000/``, ``shard-001/``, ... subdirectories).
+
+        Each shard replays snapshot-then-tail via
+        ``TpuProvider.recover``; then ownership is resolved to exactly
+        one shard per doc: a pending migration intent whose destination
+        journaled the doc is COMPLETED (the source's final state is
+        transferred, then released — the crash landed inside the
+        double-delivery window, so the destination may be missing the
+        source's tail but never the reverse after the transfer); an
+        intent whose destination never admitted the doc is ABORTED (the
+        source keeps it).  Both resolutions journal durably, so
+        re-crashing mid-recovery re-converges to the same owner."""
+        root = Path(wal_root)
+        shard_dirs = sorted(
+            d for d in root.iterdir()
+            if d.is_dir() and d.name.startswith("shard-")
+        )
+        if not shard_dirs:
+            raise ValueError(f"no shard-*/ WAL directories under {root}")
+        shards = [
+            TpuProvider.recover(
+                d,
+                n_docs=docs_per_shard,
+                root_name=root_name,
+                mesh=meshes[k] if meshes else None,
+                gc=gc,
+                backend=backend,
+                wal_config=wal_config,
+            )
+            for k, d in enumerate(shard_dirs)
+        ]
+        fleet = cls(
+            docs_per_shard=docs_per_shard,
+            root_name=root_name,
+            gc=gc,
+            backend=backend,
+            wal_dir=str(root),
+            wal_config=wal_config,
+            config=config,
+            registry=registry,
+            providers=shards,
+        )
+        resolved = {"completed": 0, "aborted": 0, "deduped": 0}
+        for k, p in enumerate(shards):
+            pending = (p.last_recovery or {}).get(
+                "migrations_pending"
+            ) or {}
+            for guid, intent in sorted(pending.items()):
+                dst = intent.get("dst", -1)
+                dst_ok = 0 <= dst < len(shards) and dst != k
+                src_has = p.has_doc(guid)
+                dst_has = dst_ok and shards[dst].has_doc(guid)
+                if src_has and dst_has:
+                    # window was open: destination journaled state, so
+                    # complete the handoff — transfer the source's
+                    # final export (it may hold a tail the destination
+                    # missed), then release
+                    final = p.release_doc(guid)
+                    shards[dst].receive_update(guid, final)
+                    fleet.metrics.migrations.labels(
+                        reason="recovery-complete"
+                    ).inc()
+                    resolved["completed"] += 1
+                elif src_has:
+                    # destination never admitted the doc: abort to src
+                    fleet.metrics.migrations.labels(
+                        reason="recovery-abort"
+                    ).inc()
+                    resolved["aborted"] += 1
+                # dst-only / neither: the release record already
+                # replayed — the migration finished before the crash
+        for k, p in enumerate(shards):
+            for guid in p.guids():
+                prev = fleet.table.lookup(guid)
+                if prev is not None:
+                    # double owner with no surviving intent (should be
+                    # impossible; defensive): keep the lowest shard,
+                    # merge + release the duplicate deterministically
+                    final = p.release_doc(guid)
+                    shards[prev].receive_update(guid, final)
+                    fleet.metrics.migrations.labels(
+                        reason="recovery-dedupe"
+                    ).inc()
+                    resolved["deduped"] += 1
+                    continue
+                fleet.table.assign(guid, k)
+        fleet.table.bump()
+        fleet.last_recovery = {
+            "shards": [p.last_recovery for p in shards],
+            "resolution": resolved,
+        }
+        fleet._refresh_gauges()
+        return fleet

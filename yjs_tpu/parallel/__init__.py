@@ -1,3 +1,8 @@
 """Device-mesh parallelism: shard the doc batch across TPU cores."""
 
-from .mesh import doc_mesh, sharded_batch_step, sharded_state_vectors  # noqa: F401
+from .mesh import (  # noqa: F401
+    doc_mesh,
+    shard_meshes,
+    sharded_batch_step,
+    sharded_state_vectors,
+)

@@ -62,6 +62,42 @@ def doc_mesh(
     return Mesh(np.array(devs), (axis,))
 
 
+def shard_meshes(
+    n_shards: int,
+    axis: str = "docs",
+    backend: str | None = None,
+    devices_per_shard: int | None = None,
+) -> list[Mesh | None]:
+    """Partition the device list into per-shard 1-D doc meshes — the
+    fleet's device-placement map (ISSUE 6): shard ``k`` of a
+    :class:`yjs_tpu.fleet.FleetRouter` runs its engine over mesh ``k``,
+    so the fleet spans the whole pod while each shard's collectives stay
+    inside its own device group.
+
+    Devices are dealt out contiguously (ICI neighbors stay together on
+    real TPU topologies).  When the backend has fewer devices than
+    shards, every entry is ``None`` — the fleet then runs unmeshed on
+    the default device, which is the correct degraded mode for laptops
+    and single-chip hosts.
+    """
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    devs = jax.devices(backend) if backend else jax.devices()
+    if devices_per_shard is None:
+        devices_per_shard = len(devs) // n_shards
+    if devices_per_shard < 1 or len(devs) < n_shards * devices_per_shard:
+        return [None] * n_shards
+    import numpy as np
+
+    return [
+        Mesh(
+            np.array(devs[k * devices_per_shard : (k + 1) * devices_per_shard]),
+            (axis,),
+        )
+        for k in range(n_shards)
+    ]
+
+
 def sharded_batch_step(mesh: Mesh, axis: str = "docs"):
     """The engine step sharded over the doc axis.
 

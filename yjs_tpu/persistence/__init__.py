@@ -28,6 +28,7 @@ from .records import (
     HEADER_SIZE,
     KIND_ACK,
     KIND_DLQ,
+    KIND_MIGRATE,
     KIND_NAMES,
     KIND_RELEASE,
     KIND_SNAPSHOT,
@@ -60,6 +61,7 @@ __all__ = [
     "HEADER_SIZE",
     "KIND_ACK",
     "KIND_DLQ",
+    "KIND_MIGRATE",
     "KIND_NAMES",
     "KIND_RELEASE",
     "KIND_SNAPSHOT",

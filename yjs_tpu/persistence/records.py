@@ -8,6 +8,7 @@ record format so a single reader serves segments and checkpoints alike:
     record header (14 B, little-endian):
         magic        u16    0x7EA1
         kind         u8     1=update 2=snapshot 3=dlq 4=release 5=ack
+                            6=migrate
         flags        u8     bit0 = payload uses the V2 update encoding
         guid_len     u16
         payload_len  u32
@@ -39,12 +40,19 @@ KIND_SNAPSHOT = 2
 KIND_DLQ = 3
 KIND_RELEASE = 4
 KIND_ACK = 5
+# migration intent (ISSUE 6): journaled on the SOURCE shard before any
+# state reaches the destination, so crash-mid-migration recovery can
+# resolve ownership to exactly one shard.  Payload is JSON
+# {"dst": shard, "epoch": routing_epoch}; a later KIND_RELEASE for the
+# same guid marks the handoff complete.
+KIND_MIGRATE = 6
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
     KIND_DLQ: "dlq",
     KIND_RELEASE: "release",
     KIND_ACK: "ack",
+    KIND_MIGRATE: "migrate",
 }
 
 FLAG_V2 = 1

@@ -26,6 +26,7 @@ from pathlib import Path
 from .records import (
     KIND_ACK,
     KIND_DLQ,
+    KIND_MIGRATE,
     KIND_RELEASE,
     KIND_SNAPSHOT,
     KIND_UPDATE,
@@ -131,9 +132,12 @@ def replay_wal(
         "snapshots_applied": 0,
         "records_applied": 0,
         "dead_lettered": 0,
+        "overflowed": 0,
         "dlq_restored": 0,
         "released": 0,
         "session_acks": 0,
+        "migration_intents": 0,
+        "migrations_pending": {},
         "corrupt_records": 0,
         "torn_truncations": 0,
         "duration_s": 0.0,
@@ -193,11 +197,19 @@ def replay_wal(
             if rec.kind in (KIND_UPDATE, KIND_SNAPSHOT):
                 doc = doc_of(rec.guid)
                 if doc < 0:
+                    # the provider is full: the doc's durably-journaled
+                    # state must NOT vanish.  The record rides the DLQ
+                    # with its guid in the reason so an operator (or a
+                    # fleet rebalancer) can re-route it to a shard with
+                    # room.
                     eng._dead_letter(
-                        doc, rec.payload, rec.v2, "wal-replay-full"
+                        doc, rec.payload, rec.v2,
+                        f"wal-overflow: no free slot for {rec.guid!r}",
                     )
+                    stats["overflowed"] += 1
                     stats["dead_lettered"] += 1
-                    m.replayed.labels(disposition="dead_lettered").inc()
+                    m.overflow.inc()
+                    m.replayed.labels(disposition="overflow").inc()
                     continue
                 try:
                     validate_update(rec.payload, rec.v2)
@@ -235,8 +247,32 @@ def replay_wal(
                     m.replayed.labels(disposition="dlq_restored").inc()
             elif rec.kind == KIND_RELEASE:
                 provider._apply_release_record(rec.guid)
+                # a release after a migration intent marks the handoff
+                # complete: the doc left this shard on purpose
+                stats["migrations_pending"].pop(rec.guid, None)
                 stats["released"] += 1
                 m.replayed.labels(disposition="released").inc()
+            elif rec.kind == KIND_MIGRATE:
+                # migration intent (ISSUE 6): journaled by the source
+                # shard before any state reached the destination.  An
+                # intent with no later release means the crash landed
+                # mid-migration; FleetRouter.recover resolves ownership
+                # (destination owns iff its own WAL admitted the doc).
+                try:
+                    intent = json.loads(rec.payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    intent = None
+                if isinstance(intent, dict) and "dst" in intent:
+                    try:
+                        stats["migrations_pending"][rec.guid] = {
+                            "dst": int(intent["dst"]),
+                            "epoch": int(intent.get("epoch", 0)),
+                        }
+                    except (TypeError, ValueError):
+                        pass
+                    else:
+                        stats["migration_intents"] += 1
+                        m.replayed.labels(disposition="migrate").inc()
             elif rec.kind == KIND_ACK:
                 # session ack floor (ISSUE 5): the journaled "we hold
                 # peer session <sid> up to <seq>" fact.  Later records

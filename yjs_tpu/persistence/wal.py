@@ -118,7 +118,7 @@ class WalMetrics:
             self.segments = self.compactions = self.reclaimed = noop
             self.recoveries = self.replayed = noop
             self.torn = self.corrupt = self.replay_seconds = noop
-            self.append_seconds = noop
+            self.append_seconds = self.overflow = noop
             return
         self.records = registry.counter(
             "ytpu_wal_records_appended_total",
@@ -177,6 +177,12 @@ class WalMetrics:
             "ytpu_wal_append_seconds",
             "Wall time of one WAL append (encode + write + policy fsync)",
             unit="s",
+        )
+        self.overflow = registry.counter(
+            "ytpu_wal_recovery_overflow_total",
+            "Replayed records whose doc could not be admitted "
+            "(ProviderFullError) and were routed to the dead-letter "
+            "queue with a wal-overflow: reason",
         )
 
 

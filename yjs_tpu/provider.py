@@ -23,6 +23,7 @@ from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
     KIND_ACK,
+    KIND_MIGRATE,
     KIND_RELEASE,
     KIND_UPDATE,
     WalConfig,
@@ -207,6 +208,9 @@ class TpuProvider:
         # (guid, peer) -> (peer sid, recv floor) journaled ack facts
         # collected by replay_wal; armed onto sessions as resume hints
         self._recovered_acks: dict[tuple[str, str], tuple[int, int]] = {}
+        # fleet membership (ISSUE 6): set by FleetRouter so admission
+        # errors and dashboards name the shard, None standalone
+        self.shard_id: int | None = None
 
     # -- doc management -----------------------------------------------------
 
@@ -221,14 +225,37 @@ class TpuProvider:
                 i = self._next
                 self._next += 1
             else:
+                where = (
+                    f"shard {self.shard_id}"
+                    if self.shard_id is not None
+                    else "provider"
+                )
                 raise ProviderFullError(
-                    f"provider is full ({self.engine.n_docs} docs); "
+                    f"{where} is full ({self.engine.n_docs} docs); "
                     "release_doc() a cold room to admit "
                     f"{guid!r}"
                 )
             self._guids[guid] = i
             self._guid_of[i] = guid
         return i
+
+    def has_doc(self, guid: str) -> bool:
+        """Whether the guid currently holds an engine slot (no
+        allocation side effect, unlike :meth:`doc_id`)."""
+        return guid in self._guids
+
+    def guids(self) -> list[str]:
+        """The rooms currently admitted, sorted (stable for the fleet
+        rebalancer's deterministic candidate ordering)."""
+        return sorted(self._guids)
+
+    @property
+    def occupancy(self) -> float:
+        """Admitted docs / slot capacity — the gauge the fleet
+        rebalancer ticks on (1.0 means the next new guid raises
+        :class:`ProviderFullError`)."""
+        n = self.engine.n_docs
+        return (len(self._guids) / n) if n else 1.0
 
     def on_update(self, callback) -> None:
         """Register ``callback(guid, update_bytes)``: the flush-emitted
@@ -592,10 +619,18 @@ class TpuProvider:
         drive :meth:`tick_sessions` at the server's cadence."""
         key = (guid, str(peer))
         sess = self._sessions.get(key)
-        if sess is not None and not sess._closed:
-            return sess
-        self._ensure_session_bridge()
+        if sess is not None:
+            if not sess._closed:
+                return sess
+            # drop the closed carcass BEFORE admission: if doc_id vetoes
+            # below, the registry must hold nothing for this key — a
+            # half-registered peer would be ticked/snapshotted forever
+            del self._sessions[key]
+        # admission is atomic with registration: doc_id either allocates
+        # the slot or raises ProviderFullError with no bridge registered
+        # and no registry entry left behind
         self.doc_id(guid)  # allocate (or veto: ProviderFullError) now
+        self._ensure_session_bridge()
         host = _ProviderSessionHost(self, guid, str(peer))
         sess = SyncSession(
             host, config=config, metrics=self._session_metrics,
@@ -644,6 +679,23 @@ class TpuProvider:
             {"peer": peer, "sid": sid, "seq": seq}
         ).encode("utf-8")
         self.wal.append(KIND_ACK, guid, payload)
+
+    def journal_migration(self, guid: str, dst: int, epoch: int) -> None:
+        """Journal a migration intent (KIND_MIGRATE): "room ``guid`` is
+        moving to shard ``dst`` at routing epoch ``epoch``".  Written by
+        the fleet BEFORE any state reaches the destination; the later
+        release record marks the handoff complete.  Recovery surfaces
+        intents with no matching release as ``migrations_pending`` so
+        :meth:`yjs_tpu.fleet.FleetRouter.recover` can resolve ownership
+        to exactly one shard (no-op without a WAL — migration is then
+        safe only against in-process failures, same as every other
+        journal seam)."""
+        if self.wal is None:
+            return
+        payload = json.dumps(
+            {"dst": int(dst), "epoch": int(epoch)}
+        ).encode("utf-8")
+        self.wal.append(KIND_MIGRATE, guid, payload)
 
     def _journal_ack_floors(self) -> None:
         """Re-append every known ack floor (live sessions win over

@@ -376,6 +376,10 @@ class SyncSession:
         # resumes retransmission instead of a full resync
         self._resume_hint: tuple[int, int] | None = None
 
+        # fleet routing-table epoch this session last re-homed at
+        # (ISSUE 6); 0 = never owned by a fleet
+        self.routing_epoch = 0
+
         # per-epoch handshake bookkeeping
         self._hs_counted = False
         self._hs_diff_sent = False
@@ -938,6 +942,23 @@ class SyncSession:
     def last_ack_age(self) -> int:
         return self._tick - self._last_ack
 
+    def rehome(self, epoch: int) -> None:
+        """The host's routing epoch changed (fleet doc migration moved
+        the room to another shard).  Seq spaces, outbox, and peer
+        identity all survive — the host facade re-points transparently —
+        but the handoff window may have raced a flush, so a live
+        enhanced session immediately offers a state-vector digest: the
+        anti-entropy loop then repairs any gap with a targeted diff
+        instead of waiting out the ``antientropy`` interval."""
+        self.routing_epoch = int(epoch)
+        if (
+            not self._closed
+            and not self.plain_mode
+            and self.transport is not None
+            and self.state in (SYNCING, LIVE, LAGGING)
+        ):
+            self._send_digest()
+
     def set_resume_hint(self, peer_sid: int, recv_seq: int) -> None:
         """Arm a recovered session's HELLO with the journaled ack
         floor (see ``TpuProvider.recover``): the surviving peer then
@@ -968,5 +989,6 @@ class SyncSession:
             "shed": self.n_shed,
             "dead_lettered": self.n_dead_lettered,
             "liveness_timeouts": self.n_liveness_timeouts,
+            "routing_epoch": self.routing_epoch,
             "tick": self._tick,
         }
