@@ -971,6 +971,167 @@ def bench_fleet(n_ops: int = 200) -> dict:
     return out
 
 
+def bench_tiering(n_ops: int = 200) -> dict:
+    """Tiered doc-lifecycle cost (ISSUE 7), three parts:
+
+    - **overcommit**: N engine slots serving 50xN docs under random
+      demand — every touch past capacity is an auto-evict + promote
+      round trip; the contract is zero ``ProviderFullError``;
+    - **promotion latency**: demote→touch cycles against a WAL-backed
+      provider, warm (column hydrate, no decode) vs cold (WAL read +
+      decode + integrate) — p50/p99 per path plus the speedup ratio
+      (acceptance: warm p99 at least 5x faster than cold replay);
+    - **GC**: one forced tombstone pass over a fragmented mostly-deleted
+      hot doc — rows/bytes reclaimed.
+
+    The block is also written to BENCH_tiering.json.
+    """
+    import tempfile
+
+    import yjs_tpu as Y
+    from yjs_tpu.persistence import WalConfig
+    from yjs_tpu.provider import ProviderFullError, TpuProvider
+    from yjs_tpu.tiering import TierConfig
+
+    tier_cfg = TierConfig(enabled=True)
+    rng = random.Random(11)
+
+    # -- overcommit churn ---------------------------------------------------
+    n_slots = int(os.environ.get("YTPU_BENCH_TIER_SLOTS", "4"))
+    n_docs = int(
+        os.environ.get("YTPU_BENCH_TIER_DOCS", str(50 * n_slots))
+    )
+    n_touches = int(os.environ.get("YTPU_BENCH_TIER_TOUCHES", "300"))
+    prov = TpuProvider(n_slots, tier_config=tier_cfg)
+    full_errors = 0
+    t0 = time.perf_counter()
+    for i in range(n_docs):
+        d = Y.Doc(gc=False)
+        d.client_id = i + 1
+        d.get_text("text").insert(0, f"room {i} payload")
+        try:
+            prov.receive_update(
+                f"room-{i}", Y.encode_state_as_update(d)
+            )
+        except ProviderFullError:
+            full_errors += 1
+    admit_dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for _ in range(n_touches):
+        g = f"room-{rng.randrange(n_docs)}"
+        try:
+            prov.text(g)
+        except ProviderFullError:
+            full_errors += 1
+    touch_dt = time.perf_counter() - t1
+    tier_snap = prov.tier_snapshot()
+    overcommit = {
+        "n_slots": n_slots,
+        "n_docs": n_docs,
+        "capacity_multiplier": round(n_docs / n_slots, 1),
+        "provider_full_errors": full_errors,
+        "admissions_per_sec": (
+            round(n_docs / admit_dt, 1) if admit_dt else 0.0
+        ),
+        "touches": n_touches,
+        "touches_per_sec": (
+            round(n_touches / touch_dt, 1) if touch_dt else 0.0
+        ),
+        "resident": tier_snap["resident"],
+        "hot": tier_snap["hot"],
+        "warm": tier_snap["warm"],
+        "cold": tier_snap["cold"],
+    }
+
+    # -- promotion latency: warm hydrate vs cold replay ---------------------
+    # timed at the doc_id seam (the promotion itself): warm scatters the
+    # detached columns back into the slot, cold re-decodes and
+    # re-integrates the journaled state (flush included — that is the
+    # cost warm promotion exists to skip).  Full-size traces: on a tiny
+    # doc both paths drown in the device round-trip.
+    reps = int(os.environ.get("YTPU_BENCH_TIER_REPS", "60"))
+    promote_ops = int(
+        os.environ.get("YTPU_BENCH_TIER_PROMOTE_OPS", "1500")
+    )
+    update = load_distinct_traces(1, promote_ops)[0]
+
+    def pct(samples, p):
+        s = sorted(samples)
+        return round(s[min(len(s) - 1, int(p * len(s)))], 3)
+
+    with tempfile.TemporaryDirectory(prefix="ytpu-bench-tier") as wd:
+        # fsync="never" isolates the promotion compute path: both tiers
+        # journal identically, and periodic interval-fsyncs would spike
+        # the p99 of whichever path they happen to land in
+        p2 = TpuProvider(
+            2, wal_dir=wd, wal_config=WalConfig(fsync="never"),
+            tier_config=tier_cfg,
+        )
+        p2.receive_update("doc", update)
+        p2.flush()
+        warm_ms, cold_ms = [], []
+        for tier, sink in (("warm", warm_ms), ("cold", cold_ms)):
+            p2.demote_doc("doc", tier)  # warm the path untimed
+            p2.text("doc")
+            for _ in range(reps):
+                p2.demote_doc("doc", tier)
+                m0 = time.perf_counter()
+                p2.doc_id("doc")  # first touch = promote
+                sink.append((time.perf_counter() - m0) * 1000.0)
+        p2.close(checkpoint=False)
+    speedup = (
+        round(pct(cold_ms, 0.99) / max(1e-9, pct(warm_ms, 0.99)), 2)
+    )
+    promotion = {
+        "reps": reps,
+        "trace_ops": promote_ops,
+        "warm_ms_p50": pct(warm_ms, 0.50),
+        "warm_ms_p99": pct(warm_ms, 0.99),
+        "cold_ms_p50": pct(cold_ms, 0.50),
+        "cold_ms_p99": pct(cold_ms, 0.99),
+        "warm_vs_cold_p99_speedup": speedup,
+    }
+
+    # -- forced tombstone GC ------------------------------------------------
+    p3 = TpuProvider(
+        1,
+        tier_config=TierConfig(
+            enabled=True, gc_min_rows=32, gc_deleted_ratio=0.25
+        ),
+    )
+    d = Y.Doc(gc=False)
+    d.client_id = 5
+    t = d.get_text("text")
+    for k in range(128):  # fragmented same-client runs
+        sv = Y.encode_state_vector(d)
+        t.insert(len(t.to_string()), f"frag {k} ")
+        p3.receive_update("gc-doc", Y.encode_state_as_update(d, sv))
+        p3.flush()
+    sv = Y.encode_state_vector(d)
+    t.delete(0, len(t.to_string()) - 8)
+    p3.receive_update("gc-doc", Y.encode_state_as_update(d, sv))
+    p3.flush()
+    gc_stats = p3.tiers.gc_pass()
+    converged = p3.text("gc-doc") == t.to_string()
+
+    out = {
+        "overcommit": overcommit,
+        "promotion": promotion,
+        "gc": {
+            "docs": gc_stats["docs"],
+            "rows_reclaimed": gc_stats["rows_reclaimed"],
+            "bytes_reclaimed": gc_stats["bytes_reclaimed"],
+        },
+        "converged": converged,
+    }
+    try:
+        with open("BENCH_tiering.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -1026,6 +1187,8 @@ def main():
     network = bench_network()
     time.sleep(3)
     fleet = bench_fleet()
+    time.sleep(3)
+    tiering = bench_tiering()
     time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
@@ -1089,6 +1252,7 @@ def main():
             "durability": durability,
             "network": network,
             "fleet": fleet,
+            "tiering": tiering,
         },
     }
     if sweep is not None:

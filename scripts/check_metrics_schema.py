@@ -8,7 +8,8 @@ either side has a name the other lacks — so the docs and the exposition
 surface cannot drift apart.  Also cross-checks the resilience/chaos/
 durability/profiling/network/fleet env knobs (``YTPU_CHAOS_*`` /
 ``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` /
-``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*`` / ``YTPU_FLEET_*``)
+``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*`` / ``YTPU_FLEET_*`` /
+``YTPU_TIER_*``)
 read by the code against the knobs README documents.  Wired as a tier-1
 check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
 runnable standalone:
@@ -52,7 +53,8 @@ def registered_names() -> set[str]:
 
 
 _KNOB_RE = re.compile(
-    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET)_[A-Z0-9_]+"
+    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET|TIER)"
+    r"_[A-Z0-9_]+"
 )
 
 

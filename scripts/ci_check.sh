@@ -10,6 +10,11 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== tiering smoke (marker: tiering) =="
+# the doc-lifecycle suite (ISSUE 7) is the newest subsystem: demotion /
+# promotion / recovery-placement regressions surface fast and isolated
+python -m pytest tests/ -q -m 'tiering and not slow' -p no:cacheprovider
+
 echo "== fleet smoke (marker: fleet) =="
 # the sharded-fleet suite (ISSUE 6) runs first as a fast standalone
 # smoke: routing, migration, and recovery regressions surface before

@@ -42,6 +42,7 @@ GROUPS = (
     ("durability (WAL)", ("ytpu_wal_",)),
     ("cost attribution (prof)", ("ytpu_prof_",)),
     ("convergence SLO", ("ytpu_convergence_", "ytpu_slo_")),
+    ("tiering", ("ytpu_tier_",)),
 )
 
 

@@ -46,6 +46,9 @@ COLUMNS = (
     ("quar", 5),
     ("wal rec", 8),
     ("occup", 6),
+    ("hot", 5),
+    ("warm", 5),
+    ("cold", 5),
 )
 
 # per-shard fleet rows (rendered when a snapshot carries a "fleet"
@@ -56,6 +59,8 @@ FLEET_COLUMNS = (
     ("docs", 6),
     ("cap", 5),
     ("occup", 6),
+    ("warm", 5),
+    ("cold", 5),
     ("state", 8),
     ("dlq", 5),
     ("sess", 5),
@@ -129,6 +134,9 @@ def collect_row(
         "quar": int(_gauge(snap, "ytpu_resilience_docs_quarantined")),
         "wal rec": int(_counter_sum(snap, "ytpu_wal_records_appended_total")),
         "occup": f"{_gauge(snap, 'ytpu_prof_slot_occupancy'):.2f}",
+        "hot": int(_gauge(snap, "ytpu_tier_docs", "tier=hot")),
+        "warm": int(_gauge(snap, "ytpu_tier_docs", "tier=warm")),
+        "cold": int(_gauge(snap, "ytpu_tier_docs", "tier=cold")),
         "sessions": [
             {
                 "provider": name,
@@ -150,6 +158,8 @@ def collect_row(
                 "docs": int(sh.get("docs", 0)),
                 "cap": int(sh.get("capacity", 0)),
                 "occup": f"{float(sh.get('occupancy', 0)):.2f}",
+                "warm": int(sh.get("warm", 0)),
+                "cold": int(sh.get("cold", 0)),
                 "state": str(sh.get("state", "?")),
                 "dlq": int(sh.get("dlq", 0)),
                 "sess": int(sh.get("sessions", 0)),

@@ -70,6 +70,15 @@ def pytest_configure(config):
         "fleet: doc-sharded fleet routing, migration, and rebalancing "
         "tests",
     )
+    # "tiering" tags the heat-driven doc-lifecycle suite (ISSUE 7) —
+    # in tier-1 by default (deterministic, injected clocks, tmp-dir
+    # WALs), deselectable with -m 'not tiering'; ci_check.sh also runs
+    # it standalone
+    config.addinivalue_line(
+        "markers",
+        "tiering: hot/warm/cold doc lifecycle, demand promotion, and "
+        "tier GC tests",
+    )
 
 
 @pytest.fixture

@@ -15,9 +15,13 @@ Policy, deterministic end to end (chaos tests replay it exactly):
   (default 0.85 — close enough to ``ProviderFullError`` to matter, far
   enough to finish moving before admission fails);
 - it sheds down to ``YTPU_FLEET_REBALANCE_TARGET`` (default 0.6),
-  coldest docs first: sessionless rooms sorted by guid, then sessioned
-  rooms — migrating a room nobody is attached to is free, migrating a
-  live room costs a digest round;
+  coldest docs first: sessionless rooms before sessioned ones
+  (migrating a room nobody is attached to is free, migrating a live
+  room costs a digest round), then ascending REAL heat score from the
+  shard's :class:`~yjs_tpu.tiering.HeatTracker` — the room least
+  likely to be touched again moves first.  With tiering disabled every
+  score is 0.0 and the order degrades to the old deterministic
+  guid sort;
 - at most ``YTPU_FLEET_REBALANCE_BATCH`` migrations per tick (default
   4) across the whole fleet;
 - destinations are the least-loaded live shards with free slots; a
@@ -72,9 +76,10 @@ class Rebalancer:
                 continue
             target_docs = int(cfg.rebalance_target * cap)
             excess = fleet._load(src) - target_docs
+            tm = fleet.shards[src].tiers
             candidates = sorted(
                 fleet.shards[src].guids(),
-                key=lambda g: (g in sessioned, g),
+                key=lambda g: (g in sessioned, tm.heat_of(g), g),
             )
             for guid in candidates[:max(0, excess)]:
                 if budget <= 0:

@@ -215,12 +215,14 @@ class FleetRouter:
         config: FleetConfig | None = None,
         registry=None,
         providers: list[TpuProvider] | None = None,
+        tier_config=None,
     ):
         self.config = config if config is not None else FleetConfig()
         self._root_name = root_name
         self._gc = gc
         self._backend = backend
         self._wal_config = wal_config
+        self._tier_config = tier_config
         if wal_dir is None:
             wal_dir = os.environ.get("YTPU_WAL_DIR")
         self.wal_root = Path(wal_dir) if wal_dir else None
@@ -252,6 +254,7 @@ class FleetRouter:
                     # YTPU_WAL_DIR and share one directory
                     wal_dir=self._shard_wal_dir(k),
                     wal_config=wal_config,
+                    tier_config=tier_config,
                 )
                 for k in range(n_shards)
             ]
@@ -329,10 +332,16 @@ class FleetRouter:
         return self.shards[self.shard_of(guid)]
 
     def _load(self, s: int) -> int:
-        return len(self.shards[s]._guids)
+        # resident (hot+warm+cold), not slot occupancy: a tiered shard
+        # is "loaded" by what it owns, not by what fits on device
+        return self.shards[s].resident_docs
 
     def _capacity(self, s: int) -> int:
-        return self.shards[s].engine.n_docs
+        p = self.shards[s]
+        n = p.engine.n_docs
+        if p.tiers.enabled:
+            return n * p.tiers.config.overcommit
+        return n
 
     def _place(self, guid: str) -> int:
         try:
@@ -366,7 +375,8 @@ class FleetRouter:
 
     @property
     def doc_count(self) -> int:
-        return sum(len(p._guids) for p in self.shards)
+        # resident across tiers (equals slot count with tiering off)
+        return sum(p.resident_docs for p in self.shards)
 
     @property
     def capacity(self) -> int:
@@ -551,6 +561,11 @@ class FleetRouter:
         if mig is None:
             raise RuntimeError(f"{guid!r} is not migrating")
         src, dst = mig["src"], mig["dst"]
+        # the doc's heat travels with it — a hot doc must not land on
+        # the destination looking like the coldest room there
+        self.shards[dst].tiers.adopt_heat(
+            guid, self.shards[src].tiers.heat_of(guid)
+        )
         final = self.shards[src].release_doc(guid)
         self.shards[dst].receive_update(guid, final)
         del self._migrating[guid]
@@ -591,7 +606,7 @@ class FleetRouter:
             for k in self.live_shards
             if k != shard
         )
-        need = len(self.shards[shard]._guids)
+        need = self.shards[shard].resident_docs
         if need > free_elsewhere:
             raise FleetFullError(
                 f"cannot drain shard {shard}: {need} docs to move but "
@@ -601,7 +616,9 @@ class FleetRouter:
         self.ring.remove(shard)
         self._retired.add(shard)
         moved = 0
-        for guid in self.shards[shard].guids():
+        # resident_guids, not guids(): demoted (warm/cold) docs must
+        # leave a retiring shard too — migration promotes them first
+        for guid in self.shards[shard].tiers.resident_guids():
             if guid in self._migrating:
                 continue
             dst, _shed = self.ring.place(
@@ -627,6 +644,7 @@ class FleetRouter:
             backend=self._backend,
             wal_dir=self._shard_wal_dir(k),
             wal_config=self._wal_config,
+            tier_config=self._tier_config,
         )
         prov.shard_id = k
         self.shards.append(prov)
@@ -643,6 +661,8 @@ class FleetRouter:
         rebalancer pass.  Returns the rebalance decisions."""
         self.tick_sessions()
         decisions = self.rebalancer.tick()
+        for k in self.live_shards:
+            self.shards[k].tick_tiering()
         self._refresh_gauges()
         return decisions
 
@@ -670,6 +690,9 @@ class FleetRouter:
                 "docs": len(p._guids),
                 "capacity": p.engine.n_docs,
                 "occupancy": round(p.occupancy, 4),
+                "resident": p.resident_docs,
+                "warm": len(p.tiers.warm),
+                "cold": len(p.tiers.cold),
                 "state": "retired" if k in self._retired else "live",
                 "dlq": len(p.engine.dead_letters),
                 "sessions": sum(
@@ -714,6 +737,7 @@ class FleetRouter:
         meshes=None,
         config: FleetConfig | None = None,
         registry=None,
+        tier_config=None,
     ) -> "FleetRouter":
         """Rebuild a fleet from a crashed predecessor's WAL root
         (``shard-000/``, ``shard-001/``, ... subdirectories).
@@ -744,6 +768,7 @@ class FleetRouter:
                 gc=gc,
                 backend=backend,
                 wal_config=wal_config,
+                tier_config=tier_config,
             )
             for k, d in enumerate(shard_dirs)
         ]
@@ -757,6 +782,7 @@ class FleetRouter:
             config=config,
             registry=registry,
             providers=shards,
+            tier_config=tier_config,
         )
         resolved = {"completed": 0, "aborted": 0, "deduped": 0}
         for k, p in enumerate(shards):
@@ -766,8 +792,13 @@ class FleetRouter:
             for guid, intent in sorted(pending.items()):
                 dst = intent.get("dst", -1)
                 dst_ok = 0 <= dst < len(shards) and dst != k
-                src_has = p.has_doc(guid)
-                dst_has = dst_ok and shards[dst].has_doc(guid)
+                # tier_of, not has_doc: a recovered doc may have landed
+                # warm/cold — it is still owned by that shard
+                src_has = p.tiers.tier_of(guid) is not None
+                dst_has = (
+                    dst_ok
+                    and shards[dst].tiers.tier_of(guid) is not None
+                )
                 if src_has and dst_has:
                     # window was open: destination journaled state, so
                     # complete the handoff — transfer the source's

@@ -349,3 +349,99 @@ class EngineObs:
         snap["flush_history"] = self.history.snapshot()
         snap["n_flushes_recorded"] = self.history.total
         return snap
+
+
+class TierMetrics:
+    """The ``ytpu_tier_*`` families (ISSUE 7): doc-lifecycle tiering.
+
+    Registered unconditionally at provider construction (the schema
+    checker instantiates ``TpuProvider(1)`` and expects every family
+    live) on the provider's engine registry, so per-shard fleets get
+    per-shard tier series like every other engine family."""
+
+    TIERS = ("hot", "warm", "cold")
+
+    def __init__(self, registry: MetricsRegistry):
+        r = registry
+        self._docs = r.gauge(
+            "ytpu_tier_docs",
+            "Docs resident per lifecycle tier (hot=device slot, "
+            "warm=detached host columns, cold=WAL tier record)",
+            labelnames=("tier",),
+        )
+        self._bytes = r.gauge(
+            "ytpu_tier_bytes",
+            "Approximate bytes held by demoted docs, per tier "
+            "(warm: host mirrors; cold: encoded state blobs/records)",
+            unit="bytes",
+            labelnames=("tier",),
+        )
+        self._transitions = r.counter(
+            "ytpu_tier_transitions_total",
+            "Tier transitions, by source and destination tier",
+            labelnames=("src", "dst"),
+        )
+        self._promote_seconds = r.histogram(
+            "ytpu_tier_promote_seconds",
+            "Wall time to promote one doc back into a device slot, "
+            "by source tier",
+            unit="s",
+            labelnames=("src",),
+        )
+        self._demote_seconds = r.histogram(
+            "ytpu_tier_demote_seconds",
+            "Wall time to demote one doc, by destination tier",
+            unit="s",
+            labelnames=("dst",),
+        )
+        self._evictions = r.counter(
+            "ytpu_tier_evictions_total",
+            "Hot docs auto-demoted to admit another doc (the path that "
+            "previously raised ProviderFullError)",
+        )
+        self._gc_passes = r.counter(
+            "ytpu_tier_gc_passes_total",
+            "Forced tombstone/GC compaction passes over hot docs",
+        )
+        self._gc_rows = r.counter(
+            "ytpu_tier_gc_reclaimed_rows_total",
+            "Packed-column rows dropped by tier GC compaction",
+        )
+        self._gc_bytes = r.counter(
+            "ytpu_tier_gc_reclaimed_bytes_total",
+            "Approximate host-mirror bytes reclaimed by tier GC "
+            "compaction",
+            unit="bytes",
+        )
+        # pre-resolve label children: transitions/demotes run inside the
+        # admission path
+        self._docs_by_tier = {
+            t: self._docs.labels(tier=t) for t in self.TIERS
+        }
+        self._bytes_by_tier = {
+            t: self._bytes.labels(tier=t) for t in self.TIERS
+        }
+
+    def occupancy(self, counts: dict, nbytes: dict) -> None:
+        for t in self.TIERS:
+            self._docs_by_tier[t].set(counts.get(t, 0))
+            self._bytes_by_tier[t].set(nbytes.get(t, 0))
+
+    def transition(self, src: str, dst: str) -> None:
+        self._transitions.labels(src=src, dst=dst).inc()
+
+    def promoted(self, src: str, dt_s: float) -> None:
+        self._promote_seconds.labels(src=src).observe(dt_s)
+
+    def demoted(self, dst: str, dt_s: float) -> None:
+        self._demote_seconds.labels(dst=dst).observe(dt_s)
+
+    def evicted(self) -> None:
+        self._evictions.inc()
+
+    def gc(self, rows: int, nbytes: int) -> None:
+        self._gc_passes.inc()
+        if rows > 0:
+            self._gc_rows.inc(rows)
+        if nbytes > 0:
+            self._gc_bytes.inc(nbytes)

@@ -687,6 +687,31 @@ class DocMirror:
     def n_rows(self) -> int:
         return len(self.row_slot)
 
+    def host_nbytes(self) -> int:
+        """Rough host bytes this mirror holds (warm-tier accounting,
+        ISSUE 7): retained update payloads + interned strings + the
+        packed row/segment columns (~14 int-ish lists per row)."""
+        return (
+            sum(len(b) for b in self._bufs)
+            + len(self._strings)
+            + self.n_rows * 8 * 14
+            + self.n_segs * 8 * 6
+        )
+
+    def deleted_ratio(self) -> float:
+        """Deleted content length / total inserted length — the tier GC
+        trigger (ISSUE 7).  Computed from the host delete-range
+        bookkeeping; no device traffic."""
+        total = sum(self.state)
+        if not total:
+            return 0.0
+        deleted = sum(
+            ln
+            for ranges in self.ds.values()
+            for _clock, ln in self._union_ranges(ranges)
+        )
+        return min(1.0, deleted / total)
+
     # -- segments -----------------------------------------------------------
 
     def _intern(self, s: str) -> tuple[int, int]:

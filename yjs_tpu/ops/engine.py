@@ -289,6 +289,9 @@ class BatchEngine:
         # rollback subset of self.demotions)
         self.rollbacks: list[dict] = []
         self._update_log: list[list[tuple[bytes, bool]]] = [[] for _ in range(n_docs)]
+        # warm-promotion column scatters deferred to the next flush /
+        # device read-back: doc -> (right, deleted, seg_heads) rows
+        self._pending_hydration: dict[int, tuple] = {}
         # persistent device state (no left-link array: order is ranked from
         # right links with a host-known membership mask)
         self._cap = 0  # row capacity N (arrays are [B, N+1] with scratch row)
@@ -742,36 +745,136 @@ class BatchEngine:
         ]
         if not todo or self._right is None:
             return
-        # the mirror's host list/deleted state equals the device arrays by
-        # flush invariant (YTPU_EXPORT_DEVICE pins it), so merges are
-        # decided WITHOUT any device read-back; the device gets the
-        # rebuilt rows in one write-only scatter — the r3 gather+readback
-        # cycle was the 100k-doc scaling liability (VERDICT r3 weak #3)
+        self.last_compaction = self._compact_rows(todo, self.gc)
+
+    def _compact_rows(self, todo: list[int], gc: bool) -> list[dict]:
+        """Rebuild ``todo``'s mirrors compacted and scatter the new rows
+        into the device tables; returns per-doc row stats.
+
+        The mirror's host list/deleted state equals the device arrays by
+        flush invariant (YTPU_EXPORT_DEVICE pins it), so merges are
+        decided WITHOUT any device read-back; the device gets the
+        rebuilt rows in one write-only scatter — the r3 gather+readback
+        cycle was the 100k-doc scaling liability (VERDICT r3 weak #3)."""
         idx = self._put_r(np.asarray(todo, np.int32))
         cap1 = self._cap + 1
         seg1 = self._seg_cap + 1
         new_right = np.full((len(todo), cap1), NULL, np.int32)
         new_deleted = np.zeros((len(todo), cap1), bool)
         new_starts = np.full((len(todo), seg1), NULL, np.int32)
-        self.last_compaction = []
+        stats = []
         for j, i in enumerate(todo):
+            # a fresh rebuild supersedes any still-pending hydration
+            self._pending_hydration.pop(i, None)
             m = self.mirrors[i]
             old_n = m.n_rows
-            r, d, h = m.rebuild_compacted_self(self.gc)
+            r, d, h = m.rebuild_compacted_self(gc)
             n_new = len(r)
             new_right[j, :n_new] = r
             new_deleted[j, :n_new] = d
             new_starts[j, : len(h)] = h
             self._rows_at_compact[i] = n_new
             self._uploaded_rows[i] = 0  # renumbered: statics re-upload
-            self.last_compaction.append(
+            stats.append(
                 {"doc": i, "rows_before": old_n, "rows_after": n_new}
             )
         self._right = self._right.at[idx].set(self._put_r(new_right))
         self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
         self._starts = self._starts.at[idx].set(self._put_r(new_starts))
+        return stats
 
-    # -- doc eviction -------------------------------------------------------
+    def compact_docs(self, docs, gc: bool = True) -> list[dict]:
+        """Forced tombstone/GC compaction of specific docs (the tier GC
+        pass, ISSUE 7): rebuild their packed columns with gc'able
+        deleted runs dropped NOW, regardless of the table-doubling
+        heuristic — long-lived hot docs accumulate tombstones that the
+        amortized pass never reaches.  Docs on the CPU fallback, with
+        queued (unflushed) updates, or with no rows are skipped.
+        Returns the same per-doc row stats as ``last_compaction``."""
+        todo = [
+            i
+            for i in docs
+            if i not in self.fallback
+            and not self.mirrors[i]._incoming
+            and self.mirrors[i].n_rows > 0
+        ]
+        if not todo or self._right is None:
+            return []
+        stats = self._compact_rows(todo, gc)
+        self.last_compaction = stats
+        return stats
+
+    # -- doc eviction / tiering ---------------------------------------------
+
+    def export_doc_columns(self, doc: int):
+        """Detach and return slot ``doc``'s host mirror for warm tiering
+        (ISSUE 7).  The mirror is self-contained host state — packed
+        struct-of-arrays columns plus interned payloads, no engine
+        references — so the caller can park it off-slot and re-install
+        it later with :meth:`hydrate_doc_columns`.  Pair with
+        :meth:`reset_doc` to actually free the slot.  Flush first:
+        queued updates would stay behind in the slot's log."""
+        if doc in self.fallback:
+            raise ValueError(
+                f"doc {doc} is CPU-served; its columns live in the "
+                "fallback doc, not the packed tables"
+            )
+        if self.mirrors[doc]._incoming:
+            raise RuntimeError(
+                f"doc {doc} has un-integrated updates; flush before "
+                "exporting"
+            )
+        return self.mirrors[doc]
+
+    def hydrate_doc_columns(self, doc: int, mirror) -> dict:
+        """Re-install an exported mirror into the (reset) slot ``doc``
+        with NO decode round-trip (warm promotion, ISSUE 7): the host
+        columns are rebuilt compacted and the device scatter is
+        DEFERRED — it batches into the next flush dispatch (or the next
+        device read-back) alongside any other pending hydrations, so
+        the promotion itself is host-only work.  Statics lazily
+        re-upload from row 0 on the next flush that needs them."""
+        if doc in self.fallback:
+            raise ValueError(f"doc {doc} is CPU-served; reset it first")
+        if self.mirrors[doc].n_rows or self._update_log[doc]:
+            raise RuntimeError(f"slot {doc} is not empty; reset_doc first")
+        self.mirrors[doc] = mirror
+        self._update_log[doc] = []
+        r, d, h = mirror.rebuild_compacted_self(self.gc)
+        self._ensure_capacity(max(1, len(r)), max(1, len(h)))
+        self._pending_hydration[doc] = (r, d, h)
+        self._rows_at_compact[doc] = len(r)
+        self._uploaded_rows[doc] = 0
+        if len(r):
+            self._active_docs.add(doc)
+        return {"rows": len(r), "segs": len(h)}
+
+    def _apply_pending_hydrations(self) -> None:
+        """Scatter every deferred hydration into the device tables in
+        ONE write-only pass (the ``_compact_rows`` idiom).  Called at
+        the top of flush and before any device read-back; a no-op when
+        nothing is pending."""
+        if not self._pending_hydration:
+            return
+        pend = self._pending_hydration
+        self._pending_hydration = {}
+        todo = sorted(pend)
+        if self._right is None:
+            self._ensure_capacity(1, 1)
+        cap1 = self._cap + 1
+        seg1 = self._seg_cap + 1
+        new_right = np.full((len(todo), cap1), NULL, np.int32)
+        new_deleted = np.zeros((len(todo), cap1), bool)
+        new_starts = np.full((len(todo), seg1), NULL, np.int32)
+        for j, i in enumerate(todo):
+            r, d, h = pend[i]
+            new_right[j, : len(r)] = r
+            new_deleted[j, : len(d)] = d
+            new_starts[j, : len(h)] = h
+        idx = self._put_r(np.asarray(todo, np.int32))
+        self._right = self._right.at[idx].set(self._put_r(new_right))
+        self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
+        self._starts = self._starts.at[idx].set(self._put_r(new_starts))
 
     def reset_doc(self, doc: int) -> None:
         """Return one slot to its just-constructed state (provider
@@ -783,6 +886,7 @@ class BatchEngine:
         doc)."""
         self.mirrors[doc] = make_mirror(self.root_name)
         self.fallback.pop(doc, None)
+        self._pending_hydration.pop(doc, None)
         self._update_log[doc] = []
         self._uploaded_rows[doc] = 0
         self._rows_at_compact[doc] = 0
@@ -850,6 +954,9 @@ class BatchEngine:
 
     def _flush(self) -> None:
         t_start = time.perf_counter()
+        # deferred warm-promotion scatters land before anything reads or
+        # integrates on top of the device link tables
+        self._apply_pending_hydrations()
         with self._phase_ctx("compact"):
             self._maybe_compact()
         t_compact = time.perf_counter()
@@ -1492,6 +1599,7 @@ class BatchEngine:
             return np.asarray(rows_l, np.int64), np.asarray(dele_l, bool)
         if self._right is None:
             return np.zeros(0, np.int64), np.zeros(0, bool)
+        self._apply_pending_hydrations()  # device read-back must see them
         valid_host = np.zeros(self._right.shape[1], bool)
         n = m.n_rows
         if n:

@@ -302,6 +302,37 @@ class NativeMirror:
     def has_pending(self) -> bool:
         return bool(self._lib.ymx_has_pending(self._h))
 
+    def host_nbytes(self) -> int:
+        """Rough host bytes this mirror holds (warm-tier accounting,
+        ISSUE 7): pinned update payloads + a per-row core estimate."""
+        return (
+            sum(len(u) for u, _arr in self._py_bufs.values())
+            + self.n_rows * 96
+            + self.n_segs * 48
+        )
+
+    def deleted_ratio(self) -> float:
+        """Deleted content length / total inserted length — the tier GC
+        trigger (ISSUE 7).  Straight from the core's state/DS exports;
+        no shadow sync, no device traffic."""
+        lib, h = self._lib, self._h
+        ns = int(lib.ymx_n_slots(h))
+        if not ns:
+            return 0.0
+        state = np.empty(ns, np.int64)
+        lib.ymx_state(h, _p64(state))
+        total = int(state.sum())
+        if not total:
+            return 0.0
+        nds = int(lib.ymx_ds_count(h))
+        if not nds:
+            return 0.0
+        ds_slot = np.empty(nds, np.int64)
+        ds_clock = np.empty(nds, np.int64)
+        ds_len = np.empty(nds, np.int64)
+        lib.ymx_ds(h, _p64(ds_slot), _p64(ds_clock), _p64(ds_len))
+        return min(1.0, int(ds_len.sum()) / total)
+
     def pending_depth(self) -> int:
         return int(self._lib.ymx_pending_depth(self._h))
 

@@ -32,7 +32,10 @@ from .records import (
     KIND_NAMES,
     KIND_RELEASE,
     KIND_SNAPSHOT,
+    KIND_TIER,
     KIND_UPDATE,
+    decode_tier_payload,
+    encode_tier_payload,
     MAX_GUID,
     MAX_PAYLOAD,
     REC_MAGIC,
@@ -65,6 +68,7 @@ __all__ = [
     "KIND_NAMES",
     "KIND_RELEASE",
     "KIND_SNAPSHOT",
+    "KIND_TIER",
     "KIND_UPDATE",
     "MAX_GUID",
     "MAX_PAYLOAD",
@@ -76,7 +80,9 @@ __all__ = [
     "WalRecord",
     "WriteAheadLog",
     "count_guids",
+    "decode_tier_payload",
     "encode_record",
+    "encode_tier_payload",
     "iter_file_events",
     "list_checkpoints",
     "list_segments",

@@ -8,7 +8,7 @@ record format so a single reader serves segments and checkpoints alike:
     record header (14 B, little-endian):
         magic        u16    0x7EA1
         kind         u8     1=update 2=snapshot 3=dlq 4=release 5=ack
-                            6=migrate
+                            6=migrate 7=tier
         flags        u8     bit0 = payload uses the V2 update encoding
         guid_len     u16
         payload_len  u32
@@ -25,6 +25,7 @@ segment scans forward for the next magic and keeps going.
 
 from __future__ import annotations
 
+import json
 import struct
 import zlib
 
@@ -46,6 +47,13 @@ KIND_ACK = 5
 # {"dst": shard, "epoch": routing_epoch}; a later KIND_RELEASE for the
 # same guid marks the handoff complete.
 KIND_MIGRATE = 6
+# tier demotion marker (ISSUE 7): journaled when a doc leaves the hot
+# tier.  Payload is a length-prefixed JSON meta header ({"tier": "warm"
+# or "cold", "heat": score, "letters": [...]}) followed by the doc's
+# full ``encode_state_as_update`` bytes at demotion time — recovery
+# replays the state like a snapshot, then places the doc in the
+# recorded tier unless LATER records show it was touched again.
+KIND_TIER = 7
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
@@ -53,6 +61,7 @@ KIND_NAMES = {
     KIND_RELEASE: "release",
     KIND_ACK: "ack",
     KIND_MIGRATE: "migrate",
+    KIND_TIER: "tier",
 }
 
 FLAG_V2 = 1
@@ -142,6 +151,37 @@ def try_decode_at(data: bytes, pos: int):
         # we never wrote — treat as unparseable
         return ("bad_header", None, pos)
     return ("ok", WalRecord(kind, guid, payload, bool(flags & FLAG_V2)), end)
+
+
+def encode_tier_payload(
+    tier: str, heat: float, update: bytes, letters: list | None = None
+) -> bytes:
+    """KIND_TIER payload: ``u32 meta_len | meta JSON | update bytes``.
+
+    ``letters`` are JSON-able dead-letter dicts (base64 update bodies,
+    the DLQ snapshot shape) that rode out of the slot with the doc."""
+    meta: dict = {"tier": tier, "heat": round(float(heat), 6)}
+    if letters:
+        meta["letters"] = letters
+    mb = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    return struct.pack("<I", len(mb)) + mb + bytes(update)
+
+
+def decode_tier_payload(payload: bytes) -> tuple[dict, bytes]:
+    """Inverse of :func:`encode_tier_payload` → (meta, update bytes)."""
+    if len(payload) < 4:
+        raise ValueError("tier payload too short for meta length")
+    (mlen,) = struct.unpack_from("<I", payload, 0)
+    if 4 + mlen > len(payload):
+        raise ValueError("tier payload meta overruns record")
+    meta = json.loads(payload[4 : 4 + mlen].decode("utf-8"))
+    if not isinstance(meta, dict) or meta.get("tier") not in (
+        "hot",  # promotion marker: clears any earlier demote marker
+        "warm",
+        "cold",
+    ):
+        raise ValueError(f"tier payload meta invalid: {meta!r}")
+    return meta, payload[4 + mlen :]
 
 
 def resync(data: bytes, pos: int) -> int:

@@ -29,9 +29,11 @@ from .records import (
     KIND_MIGRATE,
     KIND_RELEASE,
     KIND_SNAPSHOT,
+    KIND_TIER,
     KIND_UPDATE,
     SEG_HEADER,
     SNAP_HEADER,
+    decode_tier_payload,
     resync,
     try_decode_at,
 )
@@ -138,6 +140,8 @@ def replay_wal(
         "session_acks": 0,
         "migration_intents": 0,
         "migrations_pending": {},
+        "tier_records": 0,
+        "tier_placements": {},
         "corrupt_records": 0,
         "torn_truncations": 0,
         "duration_s": 0.0,
@@ -162,6 +166,12 @@ def replay_wal(
             return -1
 
     saw_records = False
+    # KIND_TIER placement markers (ISSUE 7): the LAST marker for a guid
+    # stands — a "hot" promotion marker or a release clears it.  State
+    # replay and placement are separate: tier-record updates apply like
+    # snapshots as they stream by, and placement happens once, after
+    # the final flush, via TierManager.place_recovered.
+    tier_markers: dict[str, dict] = {}
     for fpath, final in sources:
         for ev in iter_file_events(fpath, final=final):
             if ev[0] == "torn":
@@ -222,6 +232,11 @@ def replay_wal(
                     m.replayed.labels(disposition="dead_lettered").inc()
                     continue
                 if eng.queue_update(doc, rec.payload, v2=rec.v2):
+                    # mark dirty NOW, not after the loop: a tiered
+                    # provider's mid-replay auto-eviction flushes
+                    # before exporting, and a gated no-op flush would
+                    # leave every slot ineligible (queued updates)
+                    provider._dirty = True
                     key = (
                         "snapshots_applied"
                         if rec.kind == KIND_SNAPSHOT
@@ -250,8 +265,65 @@ def replay_wal(
                 # a release after a migration intent marks the handoff
                 # complete: the doc left this shard on purpose
                 stats["migrations_pending"].pop(rec.guid, None)
+                tier_markers.pop(rec.guid, None)
                 stats["released"] += 1
                 m.replayed.labels(disposition="released").inc()
+            elif rec.kind == KIND_TIER:
+                try:
+                    meta, update = decode_tier_payload(rec.payload)
+                except ValueError as ve:
+                    eng._dead_letter(
+                        -1, rec.payload, False,
+                        f"wal-tier-invalid: {ve} ({rec.guid!r})",
+                    )
+                    stats["dead_lettered"] += 1
+                    m.replayed.labels(disposition="dead_lettered").inc()
+                    continue
+                stats["tier_records"] += 1
+                m.replayed.labels(disposition="tier").inc()
+                if meta["tier"] == "hot":
+                    # promotion marker: the earlier demote no longer
+                    # stands (the doc's state lives in later records)
+                    tier_markers.pop(rec.guid, None)
+                    continue
+                # demote marker: its payload is the doc's full state at
+                # demotion time — replay it like a snapshot, placement
+                # comes after the final flush
+                if update:
+                    doc = doc_of(rec.guid)
+                    if doc < 0:
+                        eng._dead_letter(
+                            doc, update, False,
+                            f"wal-overflow: no free slot for "
+                            f"{rec.guid!r}",
+                        )
+                        stats["overflowed"] += 1
+                        stats["dead_lettered"] += 1
+                        m.overflow.inc()
+                        m.replayed.labels(disposition="overflow").inc()
+                        continue
+                    try:
+                        validate_update(update)
+                    except Exception as ve:
+                        eng._dead_letter(
+                            doc, update, False,
+                            f"wal-invalid: {type(ve).__name__}: {ve}",
+                        )
+                        stats["dead_lettered"] += 1
+                        m.replayed.labels(
+                            disposition="dead_lettered"
+                        ).inc()
+                        continue
+                    if eng.queue_update(doc, update):
+                        provider._dirty = True
+                        stats["snapshots_applied"] += 1
+                    else:
+                        stats["dead_lettered"] += 1
+                        m.replayed.labels(
+                            disposition="dead_lettered"
+                        ).inc()
+                        continue
+                tier_markers[rec.guid] = meta
             elif rec.kind == KIND_MIGRATE:
                 # migration intent (ISSUE 6): journaled by the source
                 # shard before any state reached the destination.  An
@@ -301,6 +373,25 @@ def replay_wal(
         # traffic happened to trigger a flush
         provider._dirty = True
     provider.flush()
+    if tier_markers:
+        tiers = getattr(provider, "tiers", None)
+        if tiers is not None and tiers.enabled:
+            stats["tier_placements"] = tiers.place_recovered(tier_markers)
+        else:
+            # tiering off on the recovering provider: every doc stays
+            # hot, but the letters that rode the demote markers must
+            # not vanish
+            import base64
+
+            for guid, meta in sorted(tier_markers.items()):
+                doc = provider._guids.get(guid, -1)
+                for d in meta.get("letters") or []:
+                    eng._dead_letter(
+                        doc,
+                        base64.b64decode(d.get("update", "")),
+                        bool(d.get("v2")),
+                        str(d.get("reason", "tiered")),
+                    )
     dt = time.perf_counter() - t0
     stats["duration_s"] = round(dt, 6)
     if stats["corrupt_records"]:

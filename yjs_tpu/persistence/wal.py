@@ -262,8 +262,16 @@ class WriteAheadLog:
         self._f = None
         self.metrics.segments.inc()
 
-    def append(self, kind: int, guid: str, payload: bytes, v2: bool = False) -> None:
-        """Journal one record (durability per the fsync policy)."""
+    def append(
+        self, kind: int, guid: str, payload: bytes, v2: bool = False
+    ) -> tuple[Path, int, int]:
+        """Journal one record (durability per the fsync policy).
+
+        Returns a ``(path, offset, length)`` locator for the record just
+        written — the cold tier (ISSUE 7) keeps locators instead of
+        payload bytes and reads the record back on promotion.  Locators
+        dangle once ``checkpoint()`` deletes the segment; holders must
+        re-journal after a checkpoint (the ack-floor idiom)."""
         if self._dead:
             raise RuntimeError("WAL abandoned (simulated crash)")
         if self._closed:
@@ -273,6 +281,7 @@ class WriteAheadLog:
         if self._f is None or self._size >= self.config.segment_bytes:
             self._seal()
             self._open_next()
+        offset = self._size
         self._f.write(rec)
         # flush to the OS on every append: in-process readers (tests,
         # the crash harness) must see exactly what a crashed process
@@ -298,6 +307,7 @@ class WriteAheadLog:
                 (t0 - self._tracer._t0) * 1e6, dt * 1e6,
                 threading.get_ident(), {"kind": KIND_NAMES[kind]}, None,
             ))
+        return (self._path, offset, len(rec))
 
     # -- compaction ----------------------------------------------------------
 

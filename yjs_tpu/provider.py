@@ -13,6 +13,7 @@ step 1 (state vector) / step 2 (diff update) / incremental updates.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 
@@ -23,6 +24,7 @@ from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
     KIND_ACK,
+    KIND_DLQ,
     KIND_MIGRATE,
     KIND_RELEASE,
     KIND_UPDATE,
@@ -32,6 +34,7 @@ from .persistence import (
 )
 from .sync import protocol
 from .sync.session import SessionConfig, SessionMetrics, SyncSession
+from .tiering import TierManager
 from .updates import validate_update
 
 
@@ -116,6 +119,7 @@ class TpuProvider:
         backend: str = "auto",
         wal_dir=None,
         wal_config: WalConfig | None = None,
+        tier_config=None,
     ):
         self.backend = backend
         self.engine = BatchEngine(
@@ -211,19 +215,37 @@ class TpuProvider:
         # fleet membership (ISSUE 6): set by FleetRouter so admission
         # errors and dashboards name the shard, None standalone
         self.shard_id: int | None = None
+        # doc lifecycle tiering (ISSUE 7): the manager (and its
+        # ytpu_tier_* families) exists unconditionally, but demotion /
+        # auto-eviction / promotion only activate when the config says
+        # enabled — default-off keeps the hard ProviderFullError cap
+        self.tiers = TierManager(self, tier_config)
 
     # -- doc management -----------------------------------------------------
 
     def doc_id(self, guid: str) -> int:
         """The engine slot for a doc guid (allocating on first use;
-        slots freed by :meth:`release_doc` are reused first)."""
+        slots freed by :meth:`release_doc` are reused first).
+
+        With tiering enabled (ISSUE 7) this is the demand-promotion and
+        auto-eviction seam: a demoted guid is promoted back into a slot
+        (warm hydrates columns, cold replays journaled state), and a
+        full provider demotes its coldest eligible hot doc instead of
+        raising :class:`ProviderFullError`."""
         i = self._guids.get(guid)
         if i is None:
+            tiers = self.tiers
+            if tiers.enabled and tiers.tier_of(guid) is not None:
+                i = tiers.promote(guid)
+                tiers.touch(guid)
+                return i
             if self._free:
                 i = self._free.pop()
             elif self._next < self.engine.n_docs:
                 i = self._next
                 self._next += 1
+            elif tiers.enabled and tiers.make_room():
+                i = self._free.pop()
             else:
                 where = (
                     f"shard {self.shard_id}"
@@ -237,6 +259,7 @@ class TpuProvider:
                 )
             self._guids[guid] = i
             self._guid_of[i] = guid
+        self.tiers.touch(guid)
         return i
 
     def has_doc(self, guid: str) -> bool:
@@ -256,6 +279,15 @@ class TpuProvider:
         :class:`ProviderFullError`)."""
         n = self.engine.n_docs
         return (len(self._guids) / n) if n else 1.0
+
+    @property
+    def resident_docs(self) -> int:
+        """Docs this provider owns across ALL tiers — hot slots plus
+        warm/cold demoted rooms.  With tiering disabled this equals
+        ``len(self._guids)``; the fleet router balances on this, not on
+        slot occupancy, so tiered shards are compared by what they
+        actually hold."""
+        return self.tiers.resident_count()
 
     def on_update(self, callback) -> None:
         """Register ``callback(guid, update_bytes)``: the flush-emitted
@@ -630,6 +662,9 @@ class TpuProvider:
         # the slot or raises ProviderFullError with no bridge registered
         # and no registry entry left behind
         self.doc_id(guid)  # allocate (or veto: ProviderFullError) now
+        # an attached peer is a stronger liveness signal than a stray
+        # read: weight the touch so sessioned rooms out-heat idle ones
+        self.tiers.touch(guid, self.tiers.config.session_weight)
         self._ensure_session_bridge()
         host = _ProviderSessionHost(self, guid, str(peer))
         sess = SyncSession(
@@ -879,15 +914,42 @@ class TpuProvider:
         """JSON-able snapshot of the whole stack (see
         BatchEngine.metrics_snapshot), plus the provider's convergence
         SLO state under ``"slo"``."""
+        # tier snapshot FIRST: it refreshes the ytpu_tier_* gauges the
+        # engine snapshot is about to read
+        tiers = self.tiers.snapshot()
         snap = self.engine.metrics_snapshot()
         snap["slo"] = self.slo.snapshot()
         snap["sessions"] = self.sessions_snapshot()
+        snap["tiers"] = tiers
         return snap
 
     def slo_snapshot(self) -> dict:
         """Convergence-SLO state: target, per-window burn rates, and the
         ok/warning/page verdict (see :class:`yjs_tpu.obs.slo.ConvergenceTracker`)."""
         return self.slo.snapshot()
+
+    # -- tiering surface (ISSUE 7) ------------------------------------------
+
+    def demote_doc(self, guid: str, tier: str = "warm") -> bool:
+        """Manually push a hot room down a tier (``"warm"`` exports its
+        columns to host and frees the slot; ``"cold"`` additionally folds
+        it into a WAL tier record).  The room stays addressable — the
+        next :meth:`doc_id` touch promotes it back.  Raises KeyError for
+        an unknown guid, ValueError for an undemotable one (CPU-fallback
+        or observed rooms are slot-bound)."""
+        return self.tiers.demote(guid, tier)
+
+    def tick_tiering(self) -> None:
+        """Periodic tier maintenance: enforce the warm-tier bound and
+        run one tombstone/GC compaction pass over eligible hot docs.
+        No-op when tiering is disabled; the fleet router calls this from
+        its own ``tick()``."""
+        self.tiers.tick()
+
+    def tier_snapshot(self) -> dict:
+        """JSON-able tier occupancy: per-tier doc counts, host/cold
+        byte footprints, and the active ``YTPU_TIER_*`` config."""
+        return self.tiers.snapshot()
 
     # -- resilience surface (ISSUE 2) ---------------------------------------
 
@@ -967,14 +1029,17 @@ class TpuProvider:
         self.flush()
         docs = sorted(self._guid_of)
         snaps = self.engine.encode_states_batched(docs)
-        res = self.wal.checkpoint(
-            [(self._guid_of[i], s) for i, s in zip(docs, snaps)],
-            self._dump_dlq(),
-        )
+        pairs = [(self._guid_of[i], s) for i, s in zip(docs, snaps)]
+        # demoted docs join the checkpoint too (materializing cold
+        # locators BEFORE compaction deletes the segments they point at)
+        pairs.extend(self.tiers.demoted_snapshots())
+        res = self.wal.checkpoint(pairs, self._dump_dlq())
         # compaction dropped the segments the session ack floors lived
         # in: re-journal them so a crash after this checkpoint still
         # resumes peer retransmission instead of full-resyncing
         self._journal_ack_floors()
+        # same idiom for the tier demote markers + cold locators
+        self.tiers.rejournal()
         return res
 
     def close(self, checkpoint: bool = True) -> None:
@@ -993,16 +1058,43 @@ class TpuProvider:
         is snapshotted, journaled as a release record (recovery then
         knows the room left DELIBERATELY and must not resurrect it),
         and returned — the caller archives it or hands it to another
-        provider.  The slot's dead letters are dropped with it: they
-        must not be misattributed to the slot's next tenant."""
+        provider.  The slot's dead letters are PRESERVED (ISSUE 7
+        satellite; they were silently dropped before): each is re-tagged
+        to the unattributed doc=-1 with the room named in its reason —
+        never misattributed to the slot's next tenant, never lost — and
+        the re-tagged set rides a journaled DLQ record so recovery
+        keeps it too.  A demoted room releases from its tier the same
+        way, without ever touching a slot."""
         i = self._guids.get(guid)
         if i is None:
-            raise KeyError(f"unknown room {guid!r}")
+            # the room may be demoted (ISSUE 7): release from its tier
+            released = self.tiers.release(guid)
+            if released is None:
+                raise KeyError(f"unknown room {guid!r}")
+            final, letters = released
+            if self.wal is not None:
+                self.wal.append(KIND_RELEASE, guid, final)
+            self._preserve_released_letters(guid, letters)
+            self._undo.pop(guid, None)
+            self._undo_settings.pop(guid, None)
+            self._user_data = {
+                k: v for k, v in self._user_data.items() if k[0] != guid
+            }
+            self._m_evicted.inc()
+            return final
         self.flush()
         final = self.engine.encode_state_as_update(i)
         if self.wal is not None:
             self.wal.append(KIND_RELEASE, guid, final)
-        self.engine.dead_letters.take(doc=i)
+        letters = [
+            {
+                "v2": bool(e.v2),
+                "reason": e.reason,
+                "update": base64.b64encode(e.update).decode("ascii"),
+            }
+            for e in self.engine.dead_letters.take(doc=i)
+        ]
+        self._preserve_released_letters(guid, letters)
         self.engine.reset_doc(i)
         del self._guids[guid]
         del self._guid_of[i]
@@ -1012,19 +1104,57 @@ class TpuProvider:
             k: v for k, v in self._user_data.items() if k[0] != guid
         }
         self._free.append(i)
+        self.tiers.forget(guid)
         self._m_evicted.inc()
         return final
 
+    def _preserve_released_letters(
+        self, guid: str, letters: list[dict]
+    ) -> None:
+        """Re-enqueue an evicted room's dead letters unattributed
+        (doc=-1, room named in the reason) and journal them (KIND_DLQ)
+        so recovery preserves the set past the release record."""
+        if not letters:
+            return
+        dlq = self.engine.dead_letters
+        dumped = []
+        for e in letters:
+            reason = f"evicted {guid!r}: {e.get('reason', '')}"
+            dlq.append(
+                -1, base64.b64decode(e.get("update", "")),
+                bool(e.get("v2")),
+                reason,
+            )
+            dumped.append(
+                {"v2": bool(e.get("v2")), "reason": reason,
+                 "update": e.get("update", "")}
+            )
+        if self.wal is not None:
+            # guid-less letters restore to doc=-1 (see _restore_dlq)
+            self.wal.append(
+                KIND_DLQ, "",
+                json.dumps({"schema": 1, "letters": dumped}).encode(
+                    "utf-8"
+                ),
+            )
+
     def _apply_release_record(self, guid: str) -> None:
         """Recovery saw a release record: forget the room (its snapshot
-        payload is the archived state, not live traffic)."""
+        payload is the archived state, not live traffic).  The slot's
+        replay-time letters are re-tagged unattributed, mirroring
+        :meth:`release_doc` (the journaled KIND_DLQ record that follows
+        a live release re-adds the originals)."""
         i = self._guids.pop(guid, None)
         if i is None:
             return
-        self.engine.dead_letters.take(doc=i)
+        for e in self.engine.dead_letters.take(doc=i):
+            self.engine.dead_letters.append(
+                -1, e.update, e.v2, f"evicted {guid!r}: {e.reason}"
+            )
         self.engine.reset_doc(i)
         del self._guid_of[i]
         self._free.append(i)
+        self.tiers.forget(guid)
         self._m_evicted.inc()
 
     def _dump_dlq(self) -> dict:
@@ -1060,6 +1190,7 @@ class TpuProvider:
         gc: bool = False,
         backend: str = "auto",
         wal_config: WalConfig | None = None,
+        tier_config=None,
     ) -> "TpuProvider":
         """Rebuild a provider from a crashed predecessor's WAL directory.
 
@@ -1083,6 +1214,7 @@ class TpuProvider:
             backend=backend,
             wal_dir=path,
             wal_config=wal_config,
+            tier_config=tier_config,
         )
         prov.last_recovery = replay_wal(
             prov, path, exclude_from=prov.wal.first_index
