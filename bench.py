@@ -971,6 +971,200 @@ def bench_fleet(n_ops: int = 200) -> dict:
     return out
 
 
+def bench_failover() -> dict:
+    """Replication + failover cost (ISSUE 8): a live fleet under a
+    seeded mixed-profile load (edit-heavy rooms, idle rooms, a
+    reconnecting session, a session on a lossy link) loses a primary
+    shard per cycle.  Measured per cycle: detection latency in ticks
+    (kill -> detector conviction), the promotion wall time (WAL-assisted
+    materialization of every doc the victim owned, from the
+    ``ytpu_failover_seconds`` histogram), the replication lag at the
+    moment of the kill, and the unavailability window.  The revived
+    shard re-joins fenced, so the cycle repeats on a full-strength
+    fleet.  The contract alongside the numbers: zero acknowledged-update
+    loss (every room byte-identical to its uninterrupted reference) and
+    no session falling back to a second full resync.
+
+    The block is also written to BENCH_failover.json.
+    """
+    import tempfile
+
+    import yjs_tpu as Y
+    from yjs_tpu.fleet import FailoverConfig, FleetRouter
+    from yjs_tpu.persistence import WalConfig
+    from yjs_tpu.provider import TpuProvider
+    from yjs_tpu.resilience import NetChaosConfig, NetworkFaultInjector
+    from yjs_tpu.sync.session import SessionConfig
+    from yjs_tpu.sync.transport import PipeNetwork
+
+    n_shards = int(os.environ.get("YTPU_BENCH_FAILOVER_SHARDS", "4"))
+    cycles = int(os.environ.get("YTPU_BENCH_FAILOVER_CYCLES", "6"))
+    rounds = int(os.environ.get("YTPU_BENCH_FAILOVER_ROUNDS", "20"))
+    rng = random.Random(23)
+    # profile mix: who edits how often per round
+    profiles = {
+        "edit-0": 0.8, "edit-1": 0.8, "edit-2": 0.6,
+        "idle-0": 0.05, "idle-1": 0.05,
+        "reconnect": 0.4, "lossy": 0.4,
+    }
+    cfg = SessionConfig(
+        retry_base=4, retry_cap=16, retry_max=6, retry_jitter=0.25,
+        antientropy=8, heartbeat=0, liveness=0, hello_timeout=0, seed=23,
+    )
+    with tempfile.TemporaryDirectory(prefix="ytpu-bench-fo") as wd:
+        fleet = FleetRouter(
+            n_shards, 8, wal_dir=wd,
+            wal_config=WalConfig(fsync="never"),
+            failover_config=FailoverConfig(
+                suspect_ticks=2, confirm_ticks=1, jitter_ticks=0,
+            ),
+        )
+        peer = TpuProvider(2)
+        refs = {}
+        for g in profiles:
+            d = Y.Doc(gc=False)
+            d.client_id = 100 + len(refs)
+            refs[g] = d
+        # the lossy profile rides a faulted link; the reconnect profile
+        # gets its transport killed and re-attached every cycle
+        lossy_net = PipeNetwork(NetworkFaultInjector(NetChaosConfig(
+            seed=23, drop=0.2, duplicate=0.2, delay=0.25, reorder=0.3,
+        )))
+        clean_net = PipeNetwork()
+        tl_f, tl_p = lossy_net.pair()
+        sessions = [
+            fleet.session("lossy", "peer", cfg),
+            peer.session("lossy", "fleet", cfg),
+        ]
+        sessions[0].connect(tl_f)
+        sessions[1].connect(tl_p)
+        tr_f, tr_p = clean_net.pair()
+        sessions += [
+            fleet.session("reconnect", "peer", cfg),
+            peer.session("reconnect", "fleet", cfg),
+        ]
+        sessions[2].connect(tr_f)
+        sessions[3].connect(tr_p)
+
+        def sed(doc, text):
+            sv = Y.encode_state_vector(doc)
+            doc.get_text("text").insert(
+                rng.randrange(len(str(doc.get_text("text"))) + 1), text
+            )
+            return Y.encode_state_as_update(doc, sv)
+
+        def drive_round():
+            for g, p in profiles.items():
+                if rng.random() >= p:
+                    continue
+                u = sed(refs[g], rng.choice("abcdef "))
+                if g in ("reconnect", "lossy"):
+                    peer.receive_update(g, u)
+                else:
+                    fleet.receive_update(g, u)
+            lossy_net.pump()
+            clean_net.pump()
+            fleet.tick()
+            peer.flush()
+            peer.tick_sessions()
+
+        detection_ticks, lag_at_kill = [], []
+        refolded = 0
+        for _cyc in range(cycles):
+            for _ in range(rounds):
+                drive_round()
+            # reconnect profile: drop the clean transport, re-pair
+            clean_net.kill(tr_f, tr_p)
+            tr_f, tr_p = clean_net.pair()
+            sessions[2].attach(tr_f)
+            sessions[3].attach(tr_p)
+            # the kill: the busiest room's primary dies mid-traffic
+            victim = fleet.owner_of("edit-0")
+            if victim is None:
+                continue
+            repl_snap = fleet.repl.snapshot()
+            lag_at_kill.append(max(
+                [0, *repl_snap["lag"].values()]
+            ))
+            fleet.kill_shard(victim)
+            ticks = 0
+            while victim not in fleet._down and ticks < 64:
+                drive_round()
+                ticks += 1
+            detection_ticks.append(ticks)
+            res = fleet.revive_shard(victim)
+            refolded += len(res.get("fenced", []))
+            for _ in range(rounds // 2):
+                drive_round()
+        # settle the mesh so the convergence check is a fixpoint test
+        for _ in range(200):
+            lossy_net.pump()
+            clean_net.pump()
+            fleet.flush()
+            fleet.tick_sessions()
+            peer.flush()
+            peer.tick_sessions()
+        converged = all(
+            fleet.text(g) == str(refs[g].get_text("text"))
+            for g in profiles
+            if g not in ("reconnect", "lossy")
+        )
+        mesh_converged = all(
+            fleet.text(g) == peer.text(g)
+            for g in ("reconnect", "lossy")
+        )
+        snap = fleet.metrics_snapshot()
+        hist = snap.get("histograms", {})
+        fo_s = hist.get("ytpu_failover_seconds", {}).get("", {})
+        un_t = hist.get("ytpu_failover_unavailable_ticks", {}).get("", {})
+        counters = snap.get("counters", {})
+        full_resyncs = max(s.n_full_resyncs for s in sessions)
+
+        def srt(xs):
+            return sorted(xs) or [0]
+
+        def pct(xs, p):
+            s = srt(xs)
+            return s[min(len(s) - 1, int(p * len(s)))]
+
+        out = {
+            "n_shards": n_shards,
+            "cycles": cycles,
+            "rounds_per_cycle": rounds,
+            "profiles": {k: v for k, v in profiles.items()},
+            "detection_ticks_p50": pct(detection_ticks, 0.50),
+            "detection_ticks_p99": pct(detection_ticks, 0.99),
+            "promotion_ms_p50": round(
+                float(fo_s.get("p50", 0.0)) * 1000.0, 3
+            ),
+            "promotion_ms_p99": round(
+                float(fo_s.get("p99", 0.0)) * 1000.0, 3
+            ),
+            "unavailable_ticks_p50": float(un_t.get("p50", 0.0)),
+            "unavailable_ticks_p99": float(un_t.get("p99", 0.0)),
+            "replication_lag_at_kill_max": max([0, *lag_at_kill]),
+            "promotions_total": int(
+                counters.get("ytpu_failover_promotions_total", {})
+                .get("outcome=promoted", 0)
+            ),
+            "fenced_total": int(
+                counters.get("ytpu_failover_fenced_total", {})
+                .get("", 0)
+            ),
+            "revive_refolded_docs": refolded,
+            "max_full_resyncs_per_session": full_resyncs,
+            "converged": converged,
+            "mesh_converged": mesh_converged,
+        }
+        fleet.close(checkpoint=False)
+    try:
+        with open("BENCH_failover.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def bench_tiering(n_ops: int = 200) -> dict:
     """Tiered doc-lifecycle cost (ISSUE 7), three parts:
 
@@ -1190,6 +1384,8 @@ def main():
     time.sleep(3)
     tiering = bench_tiering()
     time.sleep(3)
+    failover = bench_failover()
+    time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
         prefix = os.environ.get("YTPU_BENCH_OBS_PREFIX", "BENCH_obs")
@@ -1253,6 +1449,7 @@ def main():
             "network": network,
             "fleet": fleet,
             "tiering": tiering,
+            "failover": failover,
         },
     }
     if sweep is not None:

@@ -10,6 +10,12 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== failover smoke (marker: failover) =="
+# the replication + failure-detection suite (ISSUE 8) is the newest
+# subsystem: fan-out, detector, promotion, and fencing regressions
+# surface fast and isolated
+python -m pytest tests/ -q -m 'failover and not slow' -p no:cacheprovider
+
 echo "== tiering smoke (marker: tiering) =="
 # the doc-lifecycle suite (ISSUE 7) is the newest subsystem: demotion /
 # promotion / recovery-placement regressions surface fast and isolated

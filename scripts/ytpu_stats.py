@@ -43,6 +43,7 @@ GROUPS = (
     ("cost attribution (prof)", ("ytpu_prof_",)),
     ("convergence SLO", ("ytpu_convergence_", "ytpu_slo_")),
     ("tiering", ("ytpu_tier_",)),
+    ("replication", ("ytpu_repl_", "ytpu_failover_")),
 )
 
 

@@ -62,11 +62,14 @@ FLEET_COLUMNS = (
     ("warm", 5),
     ("cold", 5),
     ("state", 8),
+    ("role", 8),
     ("dlq", 5),
     ("sess", 5),
     ("migr", 5),
     ("in", 4),
     ("out", 4),
+    ("repl", 5),
+    ("rlag", 5),
 )
 
 # per-peer session rows (rendered as a second table when any provider
@@ -161,11 +164,14 @@ def collect_row(
                 "warm": int(sh.get("warm", 0)),
                 "cold": int(sh.get("cold", 0)),
                 "state": str(sh.get("state", "?")),
+                "role": str(sh.get("role", "?")),
                 "dlq": int(sh.get("dlq", 0)),
                 "sess": int(sh.get("sessions", 0)),
                 "migr": int(sh.get("migrating", 0)),
                 "in": int(sh.get("mig_in", 0)),
                 "out": int(sh.get("mig_out", 0)),
+                "repl": int(sh.get("repl_docs", 0)),
+                "rlag": int(sh.get("repl_lag", 0)),
             }
             for sh in (snap.get("fleet") or {}).get("shards", [])
         ],

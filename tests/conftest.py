@@ -79,6 +79,15 @@ def pytest_configure(config):
         "tiering: hot/warm/cold doc lifecycle, demand promotion, and "
         "tier GC tests",
     )
+    # "failover" tags the replication + failure-detection suite
+    # (ISSUE 8) — in tier-1 by default (tick-deterministic detector,
+    # seeded chaos), deselectable with -m 'not failover';
+    # ci_check.sh also runs it standalone
+    config.addinivalue_line(
+        "markers",
+        "failover: shard replication, failure detection, and "
+        "automatic-failover tests",
+    )
 
 
 @pytest.fixture

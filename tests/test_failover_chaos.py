@@ -1,0 +1,279 @@
+"""Failover chaos suite (ISSUE 8 acceptance): primaries are killed at
+the nastiest moments — mid-flush with the engine queue dirty and the
+replication outbox undrained, and inside the checkpoint window right
+after WAL compaction folded the replica history — and a three-peer
+session mesh rides through a primary kill under the full
+``YTPU_CHAOS_NET_*`` fault mix (drop / duplicate / delay / reorder /
+partition) across 20 seeds.
+
+The contract everywhere: byte-identical convergence against
+uninterrupted reference docs, zero acknowledged-update loss, exactly
+one owner per doc after promotion, and no session ever falls back to a
+second full resync (``n_full_resyncs == 1``).
+
+Deterministic end to end: seeded edits, seeded fault injectors, a
+jitter-free detector config so conviction lands on an exact tick.
+"""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.fleet import FailoverConfig, FleetRouter
+from yjs_tpu.persistence import WalConfig
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.resilience import NetChaosConfig, NetworkFaultInjector
+from yjs_tpu.sync.session import SessionConfig
+from yjs_tpu.sync.transport import PipeNetwork
+from yjs_tpu.updates import (
+    apply_update,
+    encode_state_as_update,
+    encode_state_vector,
+)
+
+pytestmark = [
+    pytest.mark.failover, pytest.mark.fleet, pytest.mark.chaos,
+]
+
+SMALL = WalConfig(segment_bytes=256, fsync="never")
+FAST = FailoverConfig(suspect_ticks=2, confirm_ticks=1, jitter_ticks=0)
+
+# the full fault mix from the network-chaos acceptance matrix, and the
+# same 20-seed spread
+STORM = dict(drop=0.2, duplicate=0.2, delay=0.25, reorder=0.3,
+             partition=0.04)
+STORM_SEEDS = tuple(range(20))
+
+MESH_CONFIG = dict(
+    retry_base=4, retry_cap=16, retry_max=6, retry_jitter=0.25,
+    antientropy=8, heartbeat=0, liveness=0, hello_timeout=0,
+)
+
+
+def seeded_rooms(seed, n_rooms=6, n_ops=10):
+    out = {}
+    for j in range(n_rooms):
+        gen = random.Random(seed * 1000 + j)
+        d = Y.Doc(gc=False)
+        d.client_id = 100 + j
+        updates = []
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        t = d.get_text("text")
+        for _ in range(n_ops):
+            if len(t) and gen.random() < 0.3:
+                t.delete(gen.randrange(len(t)), 1)
+            else:
+                t.insert(gen.randrange(len(t) + 1), gen.choice("abcdef "))
+        out[f"room-{j}"] = (d, updates)
+    return out
+
+
+def edit(doc, text, pos=0):
+    sv = encode_state_vector(doc)
+    doc.get_text("text").insert(pos, text)
+    return encode_state_as_update(doc, sv)
+
+
+def canonical(fleet, guid):
+    return Y.merge_updates([fleet.encode_state_as_update(guid)])
+
+
+def canonical_doc(doc):
+    return Y.merge_updates([encode_state_as_update(doc)])
+
+
+def slot_owners(fleet):
+    out = {}
+    for k, p in enumerate(fleet.shards):
+        if fleet._is_stub(k):
+            continue
+        for g in p.guids():
+            out.setdefault(g, []).append(k)
+    return out
+
+
+def convict(fleet, shard, budget=16):
+    for _ in range(budget):
+        fleet.tick()
+        if shard in fleet._down:
+            return
+    raise AssertionError(f"shard {shard} never convicted")
+
+
+def test_kill_primary_mid_flush_loses_nothing(tmp_path):
+    """The primary dies with acknowledged updates still sitting in its
+    engine queue (never flushed) and in the replication outbox (never
+    drained).  Acknowledged means durable: promotion must surface every
+    one of them from the synchronous absorb / queued-outbox paths."""
+    fleet = FleetRouter(
+        3, 4, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+        failover_config=FAST,
+    )
+    rooms = seeded_rooms(seed=11)
+    for g, (_d, ups) in rooms.items():
+        for u in ups:
+            fleet.receive_update(g, u)
+    fleet.flush()
+    fleet.tick()  # replica copies seeded
+    victim = fleet.owner_of("room-0")
+    owned = [g for g in rooms if fleet.owner_of(g) == victim]
+    assert owned
+    # a fresh acked tail per owned doc: engine queue dirty, outbox
+    # undrained — then the machine dies before any flush or tick
+    for g in owned:
+        fleet.receive_update(g, edit(rooms[g][0], "tail!"))
+    fleet.kill_shard(victim)
+    convict(fleet, victim)
+    for g, (d, _ups) in rooms.items():
+        assert fleet.owner_of(g) is not None
+        assert canonical(fleet, g) == canonical_doc(d), g
+    assert all(len(v) == 1 for v in slot_owners(fleet).values())
+    # and the survivors keep taking traffic
+    g = owned[0]
+    fleet.receive_update(g, edit(rooms[g][0], "post-failover "))
+    assert canonical(fleet, g) == canonical_doc(rooms[g][0])
+
+
+def test_kill_primary_during_checkpoint_window(tmp_path):
+    """WAL compaction folds only owned docs — a primary killed right
+    inside the checkpoint window (replica history just compacted away,
+    one more acked edit in flight) must still promote losslessly from
+    the reseeded replica state plus the undrained outbox."""
+    fleet = FleetRouter(
+        3, 4, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+        failover_config=FAST,
+    )
+    rooms = seeded_rooms(seed=12)
+    for g, (_d, ups) in rooms.items():
+        for u in ups:
+            fleet.receive_update(g, u)
+    fleet.flush()
+    fleet.tick()
+    fleet.checkpoint()  # compacts every WAL, reseeds every replica pair
+    victim = fleet.owner_of("room-0")
+    owned = [g for g in rooms if fleet.owner_of(g) == victim]
+    # one acked edit lands between the checkpoint and the crash
+    fleet.receive_update(
+        "room-0", edit(rooms["room-0"][0], "in the window ")
+    )
+    fleet.kill_shard(victim)
+    convict(fleet, victim)
+    for g, (d, _ups) in rooms.items():
+        assert canonical(fleet, g) == canonical_doc(d), g
+    assert all(len(v) == 1 for v in slot_owners(fleet).values())
+    assert "in the window" in fleet.text("room-0")
+    # a re-crash after the failover replays to the same single owner
+    for k, p in enumerate(fleet.shards):
+        if not fleet._is_stub(k):
+            p.wal.abandon()
+    owners = {g: fleet.owner_of(g) for g in rooms}
+    del fleet
+    rec = FleetRouter.recover(tmp_path, backend="cpu", wal_config=SMALL)
+    for g, (d, _ups) in rooms.items():
+        assert rec.owner_of(g) == owners[g]
+        assert canonical(rec, g) == canonical_doc(d), g
+
+
+# -- the 20-seed storm matrix ------------------------------------------------
+
+
+def _storm_mesh(seed: int, tmp_path):
+    """Fleet + two peer providers in a full session mesh, every link
+    faulted with the storm mix."""
+    cfg = SessionConfig(seed=seed, **MESH_CONFIG)
+    fleet = FleetRouter(
+        3, 2, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+        failover_config=FAST,
+    )
+    pa = TpuProvider(1, backend="cpu")
+    pb = TpuProvider(1, backend="cpu")
+    nets, sessions = [], []
+    links = [
+        (fleet, "fleet", pa, "A"),
+        (fleet, "fleet", pb, "B"),
+        (pa, "A", pb, "B"),
+    ]
+    for i, (x, xn, y, yn) in enumerate(links):
+        inj = NetworkFaultInjector(
+            NetChaosConfig(seed=(seed * 31 + i) & 0x7FFFFFFF, **STORM)
+        )
+        net = PipeNetwork(inj)
+        tx, ty = net.pair(xn, yn)
+        sx = x.session("room", yn, cfg)
+        sy = y.session("room", xn, cfg)
+        sx.connect(tx)
+        sy.connect(ty)
+        nets.append(net)
+        sessions += [sx, sy]
+    return fleet, pa, pb, nets, sessions
+
+
+@pytest.mark.parametrize("seed", STORM_SEEDS)
+def test_storm_mesh_survives_primary_kill(seed, tmp_path):
+    fleet, pa, pb, nets, sessions = _storm_mesh(seed, tmp_path)
+    gen = random.Random(seed)
+    # three uninterrupted reference editors, one per replica
+    refs = {}
+    for name, cid in (("fleet", 1), ("A", 2), ("B", 3)):
+        d = Y.Doc(gc=False)
+        d.client_id = cid
+        refs[name] = d
+    targets = {"fleet": fleet, "A": pa, "B": pb}
+    all_updates = []
+
+    def maybe_edit(name):
+        if gen.random() >= 0.35:
+            return
+        d = refs[name]
+        u = edit(d, gen.choice("abcdef "), gen.randrange(
+            len(str(d.get_text("text"))) + 1
+        ))
+        # acked on return: the storm may not lose it, failover may not
+        # lose it
+        targets[name].receive_update("room", u)
+        all_updates.append(u)
+
+    def pump_all():
+        for net in nets:
+            net.pump()
+        fleet.tick()
+        for p in (pa, pb):
+            p.flush()
+            p.tick_sessions()
+
+    edit_rounds, killed = 40, False
+    stable, victim = 0, None
+    for n in range(1500):
+        if n < edit_rounds:
+            for name in ("fleet", "A", "B"):
+                maybe_edit(name)
+        if n == 15:
+            # the primary dies mid-storm with edits still streaming
+            victim = fleet.owner_of("room")
+            if victim is not None:
+                fleet.kill_shard(victim)
+                killed = True
+        pump_all()
+        if n >= edit_rounds:
+            texts = {fleet.text("room"), pa.text("room"), pb.text("room")}
+            if len(texts) == 1 and all(
+                s.state == "live" for s in sessions
+            ):
+                stable += 1
+                if stable >= 6:
+                    break
+            else:
+                stable = 0
+    assert killed and victim in fleet._down
+    assert stable >= 6, "mesh never reached a live, converged fixpoint"
+    # byte-identical across all three replicas
+    assert fleet.text("room") == pa.text("room") == pb.text("room")
+    # zero acknowledged-update loss: the merged reference stream IS the
+    # converged state
+    expected = Y.Doc(gc=False)
+    apply_update(expected, Y.merge_updates(all_updates))
+    assert fleet.text("room") == str(expected.get_text("text"))
+    # recovery was retransmission + rehome, never a second full resync
+    for s in sessions:
+        assert s.n_full_resyncs == 1, (seed, s.peer, s.snapshot())

@@ -181,7 +181,10 @@ def test_release_marker_closes_the_window_durably(tmp_path):
         tmp_path, docs_per_shard=2, backend="cpu", wal_config=SMALL,
     )
     res = rec.last_recovery["resolution"]
-    assert res == {"completed": 0, "aborted": 0, "deduped": 0}
+    assert res == {
+        "completed": 0, "aborted": 0, "deduped": 0,
+        "fenced": 0, "replicas_folded": 0, "replica_promoted": 0,
+    }
     assert rec.owner_of("room") == 1 - src
     assert slot_owners(rec)["room"] == [1 - src]
     assert rec.text("room") == "moved"

@@ -1,4 +1,4 @@
-"""Doc-sharded provider fleet (ISSUE 6).
+"""Doc-sharded provider fleet (ISSUE 6 + ISSUE 8).
 
 One :class:`TpuProvider` caps the deployment at single-device slot
 capacity.  :class:`FleetRouter` puts N provider shards behind the same
@@ -9,12 +9,27 @@ intent/release records, and an occupancy-driven :class:`Rebalancer`.
 Crash recovery (:meth:`FleetRouter.recover`) replays every shard's WAL
 and resolves mid-migration crashes to exactly one owner.
 
-Knobs: ``YTPU_FLEET_VNODES``, ``YTPU_FLEET_LOAD_FACTOR``,
-``YTPU_FLEET_REBALANCE_HIGH``, ``YTPU_FLEET_REBALANCE_TARGET``,
-``YTPU_FLEET_REBALANCE_BATCH``.  Metrics: the ``ytpu_fleet_*``
-families (README "Fleet").
+ISSUE 8 adds survivability: every accepted update fans out to R
+replica shards (:class:`ReplicationManager`, journal-only copies on the
+replicas' own WALs), a tick-deterministic heartbeat
+:class:`FailureDetector` convicts dead shards (suspect → dead with
+jittered thresholds), and :class:`FailoverCoordinator` promotes the
+freshest replica under a monotonic fencing epoch — a revived stale
+primary is fenced out, never split-brained.
+
+Knobs: ``YTPU_FLEET_*``, ``YTPU_REPL_*``, ``YTPU_FAILOVER_*``.
+Metrics: the ``ytpu_fleet_*``, ``ytpu_repl_*``, and ``ytpu_failover_*``
+families (README "Fleet" and "Replication & failover").
 """
 
+from .failover import (
+    DeadShard,
+    FailoverConfig,
+    FailoverCoordinator,
+    FailoverMetrics,
+    FailureDetector,
+    ShardDownError,
+)
 from .hashring import (
     FleetFullError,
     HashRing,
@@ -22,15 +37,29 @@ from .hashring import (
     stable_hash,
 )
 from .rebalance import Rebalancer
+from .replication import (
+    ReplicationConfig,
+    ReplicationManager,
+    ReplicationMetrics,
+)
 from .router import FleetConfig, FleetMetrics, FleetRouter
 
 __all__ = [
+    "DeadShard",
+    "FailoverConfig",
+    "FailoverCoordinator",
+    "FailoverMetrics",
+    "FailureDetector",
     "FleetConfig",
     "FleetFullError",
     "FleetMetrics",
     "FleetRouter",
     "HashRing",
     "Rebalancer",
+    "ReplicationConfig",
+    "ReplicationManager",
+    "ReplicationMetrics",
     "RoutingTable",
+    "ShardDownError",
     "stable_hash",
 ]

@@ -47,6 +47,11 @@ class Rebalancer:
         for k in fleet.live_shards:
             if k == src:
                 continue
+            # never rebalance ONTO a shard the failure detector holds
+            # suspect (or dead but unconvicted): a migration into a
+            # dying shard is data movement toward the cliff edge
+            if not fleet.shard_healthy(k):
+                continue
             load = fleet._load(k)
             if load >= fleet._capacity(k):
                 continue
@@ -71,6 +76,10 @@ class Rebalancer:
         for src in fleet.live_shards:
             if budget <= 0:
                 break
+            # a suspect/dead source has nothing safely readable to
+            # migrate; failover, not rebalancing, resolves it
+            if not fleet.shard_healthy(src):
+                continue
             cap = fleet._capacity(src)
             if not cap or fleet._load(src) / cap < cfg.rebalance_high:
                 continue

@@ -51,7 +51,15 @@ from .hashring import (
     _env_float,
     _env_int,
 )
+from .failover import (
+    DeadShard,
+    FailoverCoordinator,
+    FailoverMetrics,
+    FailureDetector,
+    ShardDownError,
+)
 from .rebalance import Rebalancer
+from .replication import ReplicationManager
 
 __all__ = ["FleetConfig", "FleetMetrics", "FleetRouter", "FleetFullError"]
 
@@ -189,14 +197,34 @@ class _FleetSessionHost:
         return self.fleet._handle_frame_routed(self.guid, frame)
 
     def dead_letter(self, payload: bytes, reason: str) -> None:
-        p = self._prov()
-        p.engine._dead_letter(
-            p.doc_id(self.guid), bytes(payload), False,
-            f"{reason} (peer {self.peer})",
+        full_reason = f"{reason} (peer {self.peer})"
+        try:
+            p = self._prov()
+            p.engine._dead_letter(
+                p.doc_id(self.guid), bytes(payload), False, full_reason,
+            )
+        except ShardDownError:
+            own = self.fleet.owner_of(self.guid)
+            if own is not None:
+                self.fleet.detector.report_down(own)
+        # quarantined evidence is replicated: it must survive the shard
+        # that quarantined it
+        self.fleet.repl.enqueue_dlq(
+            self.guid, bytes(payload), False, full_reason
         )
 
     def journal_ack(self, sid: int, seq: int) -> None:
-        self._prov().journal_session_ack(self.guid, self.peer, sid, seq)
+        try:
+            self._prov().journal_session_ack(
+                self.guid, self.peer, sid, seq
+            )
+        except ShardDownError:
+            own = self.fleet.owner_of(self.guid)
+            if own is not None:
+                self.fleet.detector.report_down(own)
+        # receive floors fan out too — a promoted replica's WAL must
+        # let surviving peers resume, not resync
+        self.fleet.repl.enqueue_ack(self.guid, self.peer, sid, seq)
 
 
 class FleetRouter:
@@ -216,6 +244,8 @@ class FleetRouter:
         registry=None,
         providers: list[TpuProvider] | None = None,
         tier_config=None,
+        repl_config=None,
+        failover_config=None,
     ):
         self.config = config if config is not None else FleetConfig()
         self._root_name = root_name
@@ -278,9 +308,24 @@ class FleetRouter:
         self._mig_out: dict[int, int] = {}
         # stats of the replay that built this fleet (recover())
         self.last_recovery: dict | None = None
+        # shards whose machine is gone (failed over, fenced out of the
+        # ring); distinct from _retired, which is a graceful drain
+        self._down: set[int] = set()
+        # killed providers kept for revival (the chaos/fencing path)
+        self._corpses: dict[int, TpuProvider] = {}
         for k, prov in enumerate(self.shards):
             prov.shard_id = k
             self._attach_bridge(k, prov)
+        self.failover_metrics = FailoverMetrics(self.metrics.registry)
+        self.detector = FailureDetector(
+            range(len(self.shards)),
+            config=failover_config,
+            metrics=self.failover_metrics,
+        )
+        self.repl = ReplicationManager(self, config=repl_config)
+        self.failover = FailoverCoordinator(
+            self, metrics=self.failover_metrics
+        )
         self.rebalancer = Rebalancer(self)
         self._refresh_gauges()
 
@@ -288,6 +333,21 @@ class FleetRouter:
 
     def _shard_wal_dir(self, k: int) -> str:
         return str(self.wal_root / f"shard-{k:03d}") if self.wal_root else ""
+
+    def _is_stub(self, k: int) -> bool:
+        return isinstance(self.shards[k], DeadShard)
+
+    def _unhealthy(self) -> set[int]:
+        """Shards no placement, replication, or migration may target:
+        gracefully retired, confirmed down, or currently suspect."""
+        return (
+            self._retired | self._down | set(self.detector.suspects())
+        )
+
+    def shard_healthy(self, k: int) -> bool:
+        """True when the shard is a valid migration/placement
+        destination (the rebalancer's gate, satellite of ISSUE 8)."""
+        return k not in self._unhealthy() and not self._is_stub(k)
 
     def _attach_bridge(self, k: int, prov: TpuProvider) -> None:
         """Fan this shard's flush-emitted updates out to fleet sessions
@@ -300,6 +360,14 @@ class FleetRouter:
             mig = self._migrating.get(guid)
             if mig is not None and mig["dst"] == _k:
                 return
+            if mig is None:
+                own = self.table.lookup(guid)
+                if own is not None and own != _k:
+                    # fencing at the wire: a shard that lost ownership
+                    # (failover promoted a replica while it was gone)
+                    # keeps its engine state but its emissions go
+                    # nowhere — exactly-one-owner seen by every peer
+                    return
             for (g, _peer), sess in list(self._sessions.items()):
                 if g == guid:
                     sess.send_update(update)
@@ -334,9 +402,16 @@ class FleetRouter:
     def _load(self, s: int) -> int:
         # resident (hot+warm+cold), not slot occupancy: a tiered shard
         # is "loaded" by what it owns, not by what fits on device
+        if self._is_stub(s):
+            return 0
         return self.shards[s].resident_docs
 
     def _capacity(self, s: int) -> int:
+        if self._is_stub(s):
+            # a dead shard the detector hasn't convicted yet: zero
+            # capacity keeps bounded-load placement off it without
+            # letting the corpse raise mid-scoring
+            return 0
         p = self.shards[s]
         n = p.engine.n_docs
         if p.tiers.enabled:
@@ -350,7 +425,7 @@ class FleetRouter:
                 self._load,
                 self._capacity,
                 self.config.load_factor,
-                exclude=self._retired,
+                exclude=self._unhealthy(),
             )
         except FleetFullError:
             self.metrics.placements.labels(kind="full").inc()
@@ -370,13 +445,17 @@ class FleetRouter:
     @property
     def live_shards(self) -> list[int]:
         return [
-            k for k in range(len(self.shards)) if k not in self._retired
+            k for k in range(len(self.shards))
+            if k not in self._retired and k not in self._down
         ]
 
     @property
     def doc_count(self) -> int:
         # resident across tiers (equals slot count with tiering off)
-        return sum(p.resident_docs for p in self.shards)
+        return sum(
+            p.resident_docs for k, p in enumerate(self.shards)
+            if not self._is_stub(k)
+        )
 
     @property
     def capacity(self) -> int:
@@ -394,24 +473,52 @@ class FleetRouter:
         idempotent, so the duplicate is free and the handoff can never
         drop an in-flight edit."""
         mig = self._migrating.get(guid)
-        accepted = self.shards[self.shard_of(guid)].receive_update(
-            guid, update, v2=v2, undoable=undoable
-        )
+        k = self.shard_of(guid)
+        try:
+            accepted = self.shards[k].receive_update(
+                guid, update, v2=v2, undoable=undoable
+            )
+        except ShardDownError:
+            # the primary's machine is gone but the detector hasn't
+            # convicted it yet: the update is accepted ONLY if it can
+            # be journaled synchronously on a replica — an ack we hand
+            # out must never depend on the corpse alone
+            self.detector.report_down(k)
+            if not self.repl.absorb(guid, update, v2=v2):
+                raise
+            accepted = True
+        else:
+            if accepted:
+                self.repl.enqueue_update(guid, update, v2=v2)
         if mig is not None:
-            self.shards[mig["dst"]].receive_update(guid, update, v2=v2)
-            self.metrics.double_delivered.inc()
+            try:
+                self.shards[mig["dst"]].receive_update(
+                    guid, update, v2=v2
+                )
+                self.metrics.double_delivered.inc()
+            except ShardDownError:
+                self.detector.report_down(mig["dst"])
         return accepted
 
     def _handle_frame_routed(self, guid: str, frame: bytes):
         mig = self._migrating.get(guid)
-        reply = self.shards[self.shard_of(guid)].handle_sync_message(
-            guid, frame
-        )
+        k = self.shard_of(guid)
+        try:
+            reply = self.shards[k].handle_sync_message(guid, frame)
+        except ShardDownError:
+            # drop the frame: the session layer's ack/retransmit and
+            # the post-failover rehome digest repair anything lost in
+            # the unavailability window
+            self.detector.report_down(k)
+            reply = None
         if mig is not None:
             # the destination sees the same frame (updates journal on
             # its WAL; read frames produce a reply we discard)
-            self.shards[mig["dst"]].handle_sync_message(guid, frame)
-            self.metrics.double_delivered.inc()
+            try:
+                self.shards[mig["dst"]].handle_sync_message(guid, frame)
+                self.metrics.double_delivered.inc()
+            except ShardDownError:
+                self.detector.report_down(mig["dst"])
         return reply
 
     def handle_sync_message(self, guid: str, message: bytes):
@@ -435,11 +542,16 @@ class FleetRouter:
 
     def flush(self) -> None:
         for k in self.live_shards:
-            self.shards[k].flush()
+            if not self._is_stub(k):
+                self.shards[k].flush()
 
     def health(self) -> dict:
         return {
-            "shards": [p.health() for p in self.shards],
+            "shards": [
+                {"shard": k, "state": "down"} if self._is_stub(k)
+                else p.health()
+                for k, p in enumerate(self.shards)
+            ],
             "fleet": self.fleet_snapshot(),
         }
 
@@ -447,24 +559,35 @@ class FleetRouter:
         if guid is not None:
             return self.provider_for(guid).dead_letters(guid)
         out = []
-        for p in self.shards:
-            out.extend(p.dead_letters())
+        for k, p in enumerate(self.shards):
+            if not self._is_stub(k):
+                out.extend(p.dead_letters())
         return out
 
     def checkpoint(self) -> list[dict | None]:
         """Checkpoint every shard's WAL, then re-journal any still-open
         migration intents (compaction drops the segments they lived in;
-        a crash after the checkpoint must still see the window)."""
-        out = [p.checkpoint() for p in self.shards]
+        a crash after the checkpoint must still see the window), and
+        reseed replica copies the same way — compaction folds only
+        OWNED docs, so each replica pair gets one fresh full-state
+        record from its live owner."""
+        out = [
+            None if self._is_stub(k) else p.checkpoint()
+            for k, p in enumerate(self.shards)
+        ]
         for guid, mig in sorted(self._migrating.items()):
+            if self._is_stub(mig["src"]):
+                continue
             self.shards[mig["src"]].journal_migration(
                 guid, mig["dst"], self.table.epoch
             )
+        self.repl.rejournal_after_checkpoint()
         return out
 
     def close(self, checkpoint: bool = True) -> None:
-        for p in self.shards:
-            p.close(checkpoint=checkpoint)
+        for k, p in enumerate(self.shards):
+            if not self._is_stub(k):
+                p.close(checkpoint=checkpoint)
 
     # -- sessions ------------------------------------------------------------
 
@@ -490,6 +613,12 @@ class FleetRouter:
             host, config=config, metrics=self._session_metrics,
             peer=str(peer),
         )
+        # arm the journaled receive floor, same as TpuProvider.session:
+        # a recovered/promoted owner's WAL knows how far this peer got,
+        # so the reconnect handshake RESUMES instead of full-resyncing
+        hint = prov._recovered_acks.get(key)
+        if hint is not None:
+            sess.set_resume_hint(*hint)
         sess.routing_epoch = self.table.epoch
         self._sessions[key] = sess
         return sess
@@ -501,8 +630,17 @@ class FleetRouter:
         self._session_metrics.set_state_gauges(self._sessions.values())
 
     def tick_sessions(self) -> None:
-        for sess in list(self._sessions.values()):
-            sess.tick()
+        for (guid, _peer), sess in list(self._sessions.items()):
+            try:
+                sess.tick()
+            except ShardDownError:
+                # the session's home shard died inside the conviction
+                # window: skip this tick (ack/retransmit repairs once
+                # failover rehomes the session) and feed the detector
+                # so conviction isn't gated on the next probe
+                k = self.table.lookup(guid)
+                if k is not None:
+                    self.detector.report_down(k)
         self._session_metrics.set_state_gauges(self._sessions.values())
 
     def sessions_snapshot(self) -> list[dict]:
@@ -534,7 +672,10 @@ class FleetRouter:
         src = self.shard_of(guid)
         if dst == src:
             raise ValueError(f"{guid!r} already lives on shard {dst}")
-        if not (0 <= dst < len(self.shards)) or dst in self._retired:
+        if (
+            not (0 <= dst < len(self.shards))
+            or not self.shard_healthy(dst)
+        ):
             raise ValueError(f"shard {dst} is not a live destination")
         src_p, dst_p = self.shards[src], self.shards[dst]
         src_p.doc_id(guid)  # KeyError-grade misuse surfaces as admission
@@ -571,6 +712,14 @@ class FleetRouter:
         del self._migrating[guid]
         self.table.assign(guid, dst)
         epoch = self.table.bump()
+        # ownership changed: the destination journals a primary role
+        # marker under the new epoch (recovery's fencing tiebreaker),
+        # sheds any replica-copy bookkeeping it had for the doc, and
+        # re-journals the live sessions' receive floors so a crash of
+        # the NEW owner still resumes peers instead of resyncing them
+        self.shards[dst].journal_repl_role(guid, "primary", epoch)
+        self.repl.owner_changed(guid, dst)
+        self.repl.rejournal_acks(guid, dst)
         self._mig_out[src] = self._mig_out.get(src, 0) + 1
         self._mig_in[dst] = self._mig_in.get(dst, 0) + 1
         self.metrics.migrations.labels(reason=mig["reason"]).inc()
@@ -601,10 +750,13 @@ class FleetRouter:
         # fail BEFORE retiring anything: a drain that would wedge
         # mid-way (no free slots for the remainder) must not leave the
         # fleet half-mutated
+        # suspect/dead shards are not drain destinations (satellite of
+        # ISSUE 8): count free capacity on HEALTHY shards only, so the
+        # fail-fast math can't promise slots a dying shard won't honor
         free_elsewhere = sum(
             self._capacity(k) - self._load(k)
             for k in self.live_shards
-            if k != shard
+            if k != shard and self.shard_healthy(k)
         )
         need = self.shards[shard].resident_docs
         if need > free_elsewhere:
@@ -623,7 +775,7 @@ class FleetRouter:
                 continue
             dst, _shed = self.ring.place(
                 guid, self._load, self._capacity,
-                self.config.load_factor, exclude=self._retired,
+                self.config.load_factor, exclude=self._unhealthy(),
             )
             self.migrate_doc(guid, dst, reason="drain")
             moved += 1
@@ -650,6 +802,7 @@ class FleetRouter:
         self.shards.append(prov)
         self._attach_bridge(k, prov)
         self.ring.add(k)
+        self.detector.add(k)
         self.table.bump()
         self._refresh_gauges()
         return k
@@ -657,14 +810,112 @@ class FleetRouter:
     # -- ticking + introspection --------------------------------------------
 
     def tick(self) -> list[dict]:
-        """One fleet tick: session time on every fleet session, then a
-        rebalancer pass.  Returns the rebalance decisions."""
+        """One fleet tick: session time, one failure-detector probe
+        round (confirmed deaths fail over immediately), a replication
+        drain, then a rebalancer pass.  Returns the rebalance
+        decisions."""
         self.tick_sessions()
+        for k, _old, new in self.detector.tick(self._probe):
+            if new == "dead":
+                self.fail_over(k)
+        self.repl.drain()
         decisions = self.rebalancer.tick()
         for k in self.live_shards:
-            self.shards[k].tick_tiering()
+            if not self._is_stub(k):
+                self.shards[k].tick_tiering()
         self._refresh_gauges()
         return decisions
+
+    def _probe(self, k: int) -> bool:
+        try:
+            self.shards[k].heartbeat()
+            return True
+        except ShardDownError:
+            return False
+
+    # -- failure detection + failover ---------------------------------------
+
+    def fail_over(self, shard: int, reason: str = "heartbeat") -> dict:
+        """Promote replicas for every doc the shard owns and fence it
+        out of routing (called by ``tick()`` on a confirmed death, or
+        directly by an operator)."""
+        return self.failover.fail_over(shard, reason=reason)
+
+    def kill_shard(self, shard: int) -> None:
+        """Chaos: the shard's machine vanishes NOW — no flush, no
+        checkpoint, WAL left as a killed process would leave it
+        (``abandon``).  Every subsequent call into the shard raises
+        :class:`ShardDownError` until the detector convicts it and
+        ``tick()`` fails it over."""
+        if not (0 <= shard < len(self.shards)):
+            raise ValueError(f"unknown shard {shard}")
+        if self._is_stub(shard):
+            return
+        prov = self.shards[shard]
+        if prov.wal is not None:
+            prov.wal.abandon()
+        self._corpses[shard] = prov
+        self.shards[shard] = DeadShard(shard)
+
+    def revive_shard(self, shard: int) -> dict:
+        """Bring a failed-over shard back as an EMPTY primary-less
+        member (fresh provider, same WAL directory — the journal
+        indices continue).  Fencing: any doc the corpse still held in
+        memory that now belongs elsewhere is merge-released into the
+        current owner (CRDT-idempotent, so a tail the corpse accepted
+        right before death is recovered, never double-applied); a doc
+        failover declared LOST (no replica) is re-placed from the
+        corpse's copy.  The revived shard never resumes ownership by
+        itself — that is the split-brain the fencing epoch exists to
+        prevent."""
+        corpse = self._corpses.pop(shard, None)
+        if corpse is None or not self._is_stub(shard):
+            raise ValueError(f"shard {shard} was not killed")
+        fresh = TpuProvider(
+            self._docs_per_shard,
+            root_name=self._root_name,
+            gc=self._gc,
+            backend=self._backend,
+            wal_dir=self._shard_wal_dir(shard),
+            wal_config=self._wal_config,
+            tier_config=self._tier_config,
+        )
+        fresh.shard_id = shard
+        self.shards[shard] = fresh
+        self._attach_bridge(shard, fresh)
+        self._down.discard(shard)
+        if shard not in self._retired:
+            self.ring.add(shard)
+        self.detector.revive(shard)
+        fenced: list[str] = []
+        readopted: list[str] = []
+        for guid in corpse.guids():
+            try:
+                corpse.flush()
+                state = corpse.encode_state_as_update(guid)
+            except Exception:
+                # the corpse's in-memory copy is unreadable (mid-flush
+                # kill); the replicas already carried everything acked
+                continue
+            own = self.owner_of(guid)
+            if own is None:
+                # failover declared it lost (no replica existed): the
+                # corpse's copy is the only one — re-place it fresh
+                self.receive_update(guid, state)
+                readopted.append(guid)
+            elif own != shard:
+                self.shards[own].receive_update(guid, state)
+                self.failover_metrics.fenced.inc()
+                fenced.append(guid)
+        epoch = self.table.bump()
+        self.metrics.epoch.set(epoch)
+        self._refresh_gauges()
+        return {
+            "shard": shard,
+            "epoch": epoch,
+            "fenced": sorted(fenced),
+            "readopted": sorted(readopted),
+        }
 
     def _refresh_gauges(self) -> None:
         m = self.metrics
@@ -673,8 +924,27 @@ class FleetRouter:
         m.epoch.set(self.table.epoch)
         for k, p in enumerate(self.shards):
             lab = str(k)
+            if self._is_stub(k):
+                m.shard_docs.labels(shard=lab).set(0)
+                m.shard_occupancy.labels(shard=lab).set(0.0)
+                continue
             m.shard_docs.labels(shard=lab).set(len(p._guids))
             m.shard_occupancy.labels(shard=lab).set(round(p.occupancy, 6))
+
+    def _shard_role(self, k: int) -> str:
+        """One word for the ytpu_top ROLE column: what this shard IS
+        to the docs it touches right now."""
+        if self._is_stub(k) or k in self._down:
+            return "dead"
+        if self.detector.state_of(k) == "suspect":
+            return "suspect"
+        if k in self._retired:
+            return "retired"
+        if self.table.docs_on(k):
+            return "primary"
+        if self.repl.copies_on(k):
+            return "replica"
+        return "idle"
 
     def fleet_snapshot(self) -> dict:
         """JSON-able fleet state — the ``ytpu_top`` fleet-table feed."""
@@ -685,16 +955,26 @@ class FleetRouter:
             for s in (mig["src"], mig["dst"]):
                 migrating_by_shard[s] = migrating_by_shard.get(s, 0) + 1
         for k, p in enumerate(self.shards):
+            dead = self._is_stub(k)
+            if dead:
+                state = "down"
+            elif k in self._down:
+                state = "down"
+            elif k in self._retired:
+                state = "retired"
+            else:
+                state = "live"
             rows.append({
                 "shard": k,
-                "docs": len(p._guids),
-                "capacity": p.engine.n_docs,
-                "occupancy": round(p.occupancy, 4),
-                "resident": p.resident_docs,
-                "warm": len(p.tiers.warm),
-                "cold": len(p.tiers.cold),
-                "state": "retired" if k in self._retired else "live",
-                "dlq": len(p.engine.dead_letters),
+                "docs": 0 if dead else len(p._guids),
+                "capacity": 0 if dead else p.engine.n_docs,
+                "occupancy": 0.0 if dead else round(p.occupancy, 4),
+                "resident": 0 if dead else p.resident_docs,
+                "warm": 0 if dead else len(p.tiers.warm),
+                "cold": 0 if dead else len(p.tiers.cold),
+                "state": state,
+                "role": self._shard_role(k),
+                "dlq": 0 if dead else len(p.engine.dead_letters),
                 "sessions": sum(
                     1 for (g, _pr) in self._sessions
                     if self.owner_of(g) == k
@@ -702,6 +982,8 @@ class FleetRouter:
                 "migrating": migrating_by_shard.get(k, 0),
                 "mig_in": self._mig_in.get(k, 0),
                 "mig_out": self._mig_out.get(k, 0),
+                "repl_docs": len(self.repl.copies_on(k)),
+                "repl_lag": self.repl.lag(k),
             })
         return {
             "epoch": self.table.epoch,
@@ -710,6 +992,7 @@ class FleetRouter:
             "docs": self.doc_count,
             "capacity": self.capacity,
             "migrations_active": len(self._migrating),
+            "replication": self.repl.snapshot(),
             "shards": rows,
         }
 
@@ -717,7 +1000,11 @@ class FleetRouter:
         """Merged per-shard snapshots + the fleet table (file mode for
         ``ytpu_top``: any shard snapshot already carries the global
         ``ytpu_fleet_*`` families; this adds the structured rows)."""
-        snap = self.shards[0].metrics_snapshot() if self.shards else {}
+        snap = {}
+        for k in range(len(self.shards)):
+            if not self._is_stub(k):
+                snap = self.shards[k].metrics_snapshot()
+                break
         snap = dict(snap)
         snap["fleet"] = self.fleet_snapshot()
         snap["sessions"] = self.sessions_snapshot()
@@ -753,14 +1040,18 @@ class FleetRouter:
         source keeps it).  Both resolutions journal durably, so
         re-crashing mid-recovery re-converges to the same owner."""
         root = Path(wal_root)
-        shard_dirs = sorted(
-            d for d in root.iterdir()
-            if d.is_dir() and d.name.startswith("shard-")
-        )
-        if not shard_dirs:
+        by_idx: dict[int, Path] = {}
+        for d in root.iterdir():
+            if not (d.is_dir() and d.name.startswith("shard-")):
+                continue
+            try:
+                by_idx[int(d.name.split("-", 1)[1])] = d
+            except ValueError:
+                continue
+        if not by_idx:
             raise ValueError(f"no shard-*/ WAL directories under {root}")
-        shards = [
-            TpuProvider.recover(
+        recovered: dict[int, TpuProvider] = {
+            k: TpuProvider.recover(
                 d,
                 n_docs=docs_per_shard,
                 root_name=root_name,
@@ -770,7 +1061,27 @@ class FleetRouter:
                 wal_config=wal_config,
                 tier_config=tier_config,
             )
-            for k, d in enumerate(shard_dirs)
+            for k, d in sorted(by_idx.items())
+        }
+        # shard ids are positional: a WAL directory lost with its
+        # machine leaves a gap, filled by an empty member at the same
+        # id (its docs live on as replica copies on surviving shards,
+        # promoted by the role resolution below)
+        n_docs_fill = docs_per_shard or max(
+            (p.engine.n_docs for p in recovered.values()), default=1
+        )
+        shards = [
+            recovered.get(k) or TpuProvider(
+                n_docs_fill,
+                root_name=root_name,
+                mesh=meshes[k] if meshes else None,
+                gc=gc,
+                backend=backend,
+                wal_dir=str(root / f"shard-{k:03d}"),
+                wal_config=wal_config,
+                tier_config=tier_config,
+            )
+            for k in range(max(by_idx) + 1)
         ]
         fleet = cls(
             docs_per_shard=docs_per_shard,
@@ -818,22 +1129,68 @@ class FleetRouter:
                     resolved["aborted"] += 1
                 # dst-only / neither: the release record already
                 # replayed — the migration finished before the crash
+        resolved["fenced"] = 0
+        resolved["replicas_folded"] = 0
+        resolved["replica_promoted"] = 0
+        # journaled role markers per shard: guid -> {"role", "epoch"}
+        roles = [
+            ((p.last_recovery or {}).get("repl_roles") or {})
+            for p in shards
+        ]
+        claims: dict[str, list[tuple[int, str | None, int]]] = {}
         for k, p in enumerate(shards):
             for guid in p.guids():
-                prev = fleet.table.lookup(guid)
-                if prev is not None:
-                    # double owner with no surviving intent (should be
-                    # impossible; defensive): keep the lowest shard,
-                    # merge + release the duplicate deterministically
-                    final = p.release_doc(guid)
-                    shards[prev].receive_update(guid, final)
-                    fleet.metrics.migrations.labels(
-                        reason="recovery-dedupe"
-                    ).inc()
-                    resolved["deduped"] += 1
+                info = roles[k].get(guid) or {}
+                claims.setdefault(guid, []).append(
+                    (k, info.get("role"), int(info.get("epoch", 0)))
+                )
+        for guid, cs in sorted(claims.items()):
+            # fencing-epoch rules: replica-marked holders are never
+            # owner candidates while any primary claim survives;
+            # conflicting primary claims resolve to the HIGHEST
+            # journaled epoch (the latest failover/migration won), ties
+            # and unmarked holders (epoch 0) to the lowest shard id
+            primaries = sorted(
+                ((e, k) for (k, role, e) in cs if role != "replica"),
+                key=lambda t: (-t[0], t[1]),
+            )
+            if primaries:
+                owner = primaries[0][1]
+            else:
+                # only replica copies survived (the primary's WAL
+                # directory is gone): promote the freshest-marked one
+                owner = sorted(
+                    ((e, k) for (k, role, e) in cs),
+                    key=lambda t: (-t[0], t[1]),
+                )[0][1]
+                fleet.failover_metrics.promotions.labels(
+                    outcome="recovered"
+                ).inc()
+                resolved["replica_promoted"] += 1
+            fleet.table.assign(guid, owner)
+            for (k, role, _e) in cs:
+                if k == owner:
                     continue
-                fleet.table.assign(guid, k)
+                # fold the losing copy into the owner, then release it
+                # (CRDT-idempotent merge: a tail only the loser held is
+                # recovered, shared state dedupes)
+                final = shards[k].release_doc(guid)
+                shards[owner].receive_update(guid, final)
+                if role == "replica":
+                    reason = "recovery-replica"
+                    resolved["replicas_folded"] += 1
+                elif primaries and primaries[0][0] > _e:
+                    # a primary claim (marked, or an original unmarked
+                    # owner at epoch 0) outlived by a higher fencing
+                    # epoch: the stale primary is fenced, not deduped
+                    reason = "recovery-fenced"
+                    resolved["fenced"] += 1
+                else:
+                    reason = "recovery-dedupe"
+                    resolved["deduped"] += 1
+                fleet.metrics.migrations.labels(reason=reason).inc()
         fleet.table.bump()
+        fleet.repl.repair_all()
         fleet.last_recovery = {
             "shards": [p.last_recovery for p in shards],
             "resolution": resolved,

@@ -31,6 +31,7 @@ from .records import (
     KIND_MIGRATE,
     KIND_NAMES,
     KIND_RELEASE,
+    KIND_REPL,
     KIND_SNAPSHOT,
     KIND_TIER,
     KIND_UPDATE,
@@ -67,6 +68,7 @@ __all__ = [
     "KIND_MIGRATE",
     "KIND_NAMES",
     "KIND_RELEASE",
+    "KIND_REPL",
     "KIND_SNAPSHOT",
     "KIND_TIER",
     "KIND_UPDATE",

@@ -54,6 +54,15 @@ KIND_MIGRATE = 6
 # replays the state like a snapshot, then places the doc in the
 # recorded tier unless LATER records show it was touched again.
 KIND_TIER = 7
+# replication role marker (ISSUE 8): journaled on a shard whose WAL
+# holds a doc it does not OWN (a replica copy), and on a shard that
+# just won ownership via failover promotion.  Payload is JSON
+# {"role": "replica" | "primary", "epoch": fencing_epoch,
+# "primary": shard?}; the LAST marker for a guid stands and a
+# KIND_RELEASE clears it.  Recovery uses the markers to resolve
+# ownership without treating replica journals as split-brain owners,
+# and to fence a stale primary's claim behind a newer promotion epoch.
+KIND_REPL = 8
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
@@ -62,6 +71,7 @@ KIND_NAMES = {
     KIND_ACK: "ack",
     KIND_MIGRATE: "migrate",
     KIND_TIER: "tier",
+    KIND_REPL: "repl",
 }
 
 FLAG_V2 = 1

@@ -28,6 +28,7 @@ from .records import (
     KIND_DLQ,
     KIND_MIGRATE,
     KIND_RELEASE,
+    KIND_REPL,
     KIND_SNAPSHOT,
     KIND_TIER,
     KIND_UPDATE,
@@ -140,6 +141,8 @@ def replay_wal(
         "session_acks": 0,
         "migration_intents": 0,
         "migrations_pending": {},
+        "repl_markers": 0,
+        "repl_roles": {},
         "tier_records": 0,
         "tier_placements": {},
         "corrupt_records": 0,
@@ -265,6 +268,7 @@ def replay_wal(
                 # a release after a migration intent marks the handoff
                 # complete: the doc left this shard on purpose
                 stats["migrations_pending"].pop(rec.guid, None)
+                stats["repl_roles"].pop(rec.guid, None)
                 tier_markers.pop(rec.guid, None)
                 stats["released"] += 1
                 m.replayed.labels(disposition="released").inc()
@@ -345,6 +349,31 @@ def replay_wal(
                     else:
                         stats["migration_intents"] += 1
                         m.replayed.labels(disposition="migrate").inc()
+            elif rec.kind == KIND_REPL:
+                # replication role marker (ISSUE 8): "this WAL holds the
+                # doc as a replica copy" or "this shard won ownership at
+                # fencing epoch N".  The LAST marker stands (a promotion
+                # overwrites the replica claim); a release clears it.
+                # FleetRouter.recover reads the surfaced roles to keep
+                # replica journals from looking like split-brain owners
+                # and to fence stale-primary claims behind newer epochs.
+                try:
+                    info = json.loads(rec.payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    info = None
+                if isinstance(info, dict) and info.get("role") in (
+                    "replica", "primary"
+                ):
+                    try:
+                        stats["repl_roles"][rec.guid] = {
+                            "role": str(info["role"]),
+                            "epoch": int(info.get("epoch", 0)),
+                        }
+                    except (TypeError, ValueError):
+                        pass
+                    else:
+                        stats["repl_markers"] += 1
+                        m.replayed.labels(disposition="repl").inc()
             elif rec.kind == KIND_ACK:
                 # session ack floor (ISSUE 5): the journaled "we hold
                 # peer session <sid> up to <seq>" fact.  Later records

@@ -27,6 +27,7 @@ from .persistence import (
     KIND_DLQ,
     KIND_MIGRATE,
     KIND_RELEASE,
+    KIND_REPL,
     KIND_UPDATE,
     WalConfig,
     WalMetrics,
@@ -731,6 +732,49 @@ class TpuProvider:
             {"dst": int(dst), "epoch": int(epoch)}
         ).encode("utf-8")
         self.wal.append(KIND_MIGRATE, guid, payload)
+
+    def journal_repl_role(
+        self, guid: str, role: str, epoch: int, primary: int | None = None
+    ) -> None:
+        """Journal a replication role marker (KIND_REPL): "this WAL
+        holds ``guid`` as a ``replica`` copy" or "this shard owns
+        ``guid`` as of fencing epoch ``epoch``" (promotion).  The last
+        marker for a guid stands; a release record clears it.  Recovery
+        surfaces the markers so replica journals are never mistaken for
+        split-brain owners and a stale primary's claim loses to a newer
+        promotion epoch."""
+        if self.wal is None:
+            return
+        info: dict = {"role": str(role), "epoch": int(epoch)}
+        if primary is not None:
+            info["primary"] = int(primary)
+        self.wal.append(
+            KIND_REPL, guid,
+            json.dumps(info, separators=(",", ":")).encode("utf-8"),
+        )
+
+    def journal_replica_record(
+        self, kind: int, guid: str, payload: bytes, v2: bool = False
+    ) -> bool:
+        """Append one fanned-out replication record to this shard's WAL
+        without touching the engine (replica copies are journal-only
+        until promotion materializes them).  Returns False when the
+        shard has no WAL — the caller then falls back to its in-memory
+        mirror so availability survives journal-less fleets."""
+        if self.wal is None:
+            return False
+        self.wal.append(kind, guid, payload, v2=v2)
+        return True
+
+    def heartbeat(self) -> dict:
+        """Cheap liveness probe for the fleet failure detector: touches
+        no engine state, answers from host-side bookkeeping only.  A
+        dead shard's stub raises instead."""
+        return {
+            "shard": self.shard_id,
+            "docs": len(self._guids),
+            "resident": self.resident_docs,
+        }
 
     def _journal_ack_floors(self) -> None:
         """Re-append every known ack floor (live sessions win over

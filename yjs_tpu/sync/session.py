@@ -966,6 +966,15 @@ class SyncSession:
         resync."""
         self._resume_hint = (int(peer_sid), int(recv_seq))
 
+    @property
+    def ack_floor(self) -> tuple[int, int]:
+        """The receive floor this session would journal: ``(peer sid,
+        cumulative seq received)``.  The fleet re-journals it onto a
+        doc's NEW owner (migration destination, failover promotion) so
+        the shard that answers the next handshake holds the floor and
+        the peer resumes instead of full-resyncing."""
+        return (self._peer_sid, self._recv_cum)
+
     def snapshot(self) -> dict:
         """JSON-able per-peer row (the ``sessions_snapshot()`` shape)."""
         return {
