@@ -486,6 +486,19 @@ def bench_fragmented(n_docs: int, n_chars: int) -> dict:
         "planner_ms_per_doc": round(
             m.get("t_plan_s", 0.0) / max(1, n_docs) * 1e3, 3
         ),
+        # per-phase host wall time straight off the shared
+        # new_flush_metrics() schema (the same keys every flush reports)
+        "host_phase_timers_s": {
+            k: round(m.get(k, 0.0), 5)
+            for k in (
+                "t_compact_s", "t_plan_s", "t_plan_cached_s",
+                "t_plan_cold_s", "t_pack_s", "t_dispatch_s", "t_emit_s",
+                "t_total_s",
+            )
+        },
+        "plan_threads": m.get("plan_threads", 1),
+        "plan_cache_hits": m.get("plan_cache_hits", 0),
+        "plan_cache_misses": m.get("plan_cache_misses", 0),
         "schedule_occupancy": round(m.get("schedule_occupancy", 0.0), 4),
         "n_demoted": m.get("n_demoted", 0),
     }
@@ -507,6 +520,98 @@ def load_prepend_fixture(n_chars: int) -> bytes:
     if path.exists():
         return zlib.decompress(path.read_bytes())
     return gen_prepend_fragmented(n_chars)[0]
+
+
+def bench_planner(
+    n_docs: int = 32, n_chars: int = 20000, reps: int = 5
+) -> dict:
+    """detail.planner → BENCH_planner.json: plan-cache effectiveness
+    (ISSUE 9).  Cold pass: ``YTPU_PLAN_CACHE=0``, ``reps`` fresh engines
+    each plan the prepend-fragmented fixture from scratch.  Cached pass:
+    cache enabled and pre-warmed by one throwaway engine, so the same
+    ``reps`` engines serve every doc from the frontier-keyed cache.
+    Reports cold-vs-cached per-doc plan ms (p50/p99 across flushes), the
+    cached-pass hit rate, and the Python planner's segment fast-path
+    fraction on an interleaved trace."""
+    import gc
+
+    from yjs_tpu.ops import BatchEngine
+    from yjs_tpu.ops import plan_cache
+
+    update = load_prepend_fixture(n_chars)
+
+    def one_flush() -> dict:
+        eng = BatchEngine(n_docs)
+        for i in range(n_docs):
+            eng.queue_update(i, update)
+        eng.flush()
+        m = dict(eng.last_flush_metrics or {})
+        del eng
+        gc.collect()
+        return m
+
+    old = os.environ.get("YTPU_PLAN_CACHE")
+    try:
+        os.environ["YTPU_PLAN_CACHE"] = "0"
+        plan_cache.reset_cache()
+        cold = [one_flush() for _ in range(reps)]
+        os.environ["YTPU_PLAN_CACHE"] = "1"
+        plan_cache.reset_cache()
+        one_flush()  # populate the cache
+        cached = [one_flush() for _ in range(reps)]
+    finally:
+        plan_cache.reset_cache()
+        if old is None:
+            os.environ.pop("YTPU_PLAN_CACHE", None)
+        else:
+            os.environ["YTPU_PLAN_CACHE"] = old
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, round(p / 100 * (len(xs) - 1)))]
+
+    cold_ms = [m["t_plan_s"] / n_docs * 1e3 for m in cold]
+    cach_ms = [m["t_plan_s"] / n_docs * 1e3 for m in cached]
+    hits = sum(m["plan_cache_hits"] for m in cached)
+    misses = sum(m["plan_cache_misses"] for m in cached)
+
+    # segment fast-path fraction: the Python planner on an interleaved
+    # 2-client trace (the native planner plans in C++ and reports 0)
+    from yjs_tpu.ops.columns import DocMirror
+
+    trace, _ref = gen_trace(600, seed=11)
+    pm = DocMirror("text")
+    pm.ingest(trace, False)
+    plan = pm.prepare_step()
+    n_sched = len(plan.sched)
+    fastpath_fraction = (
+        plan.fastpath_structs / n_sched if n_sched else 0.0
+    )
+
+    res = {
+        "n_docs": n_docs,
+        "chars_per_doc": n_chars,
+        "reps": reps,
+        "cold_plan_ms_per_doc_p50": round(pct(cold_ms, 50), 3),
+        "cold_plan_ms_per_doc_p99": round(pct(cold_ms, 99), 3),
+        "cached_plan_ms_per_doc_p50": round(pct(cach_ms, 50), 3),
+        "cached_plan_ms_per_doc_p99": round(pct(cach_ms, 99), 3),
+        "plan_speedup_p50": round(
+            pct(cold_ms, 50) / max(1e-9, pct(cach_ms, 50)), 2
+        ),
+        "cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "fastpath_fraction": round(fastpath_fraction, 4),
+        "fastpath_structs": plan.fastpath_structs,
+        "sched_structs": n_sched,
+    }
+    try:
+        with open("BENCH_planner.json", "w") as f:
+            json.dump(res, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -1372,6 +1477,8 @@ def main():
         int(os.environ.get("YTPU_BENCH_FRAG_CHARS", "100000")),
     )
     time.sleep(3)
+    planner = bench_planner()
+    time.sleep(3)
     b4 = bench_b4_broadcast(n_docs_b4)
     time.sleep(3)
     resilience = bench_resilience()
@@ -1427,6 +1534,7 @@ def main():
             "distinct_engine_path": distinct,
             "conflict_storm_4client": storm,
             "prepend_fragmented": frag,
+            "planner": planner,
             "sync_step2_batched": sync,
             "b4_broadcast": b4,
             "node_proxy_factor": NODE_PROXY_FACTOR,

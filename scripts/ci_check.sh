@@ -10,6 +10,12 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== planner smoke (marker: planner) =="
+# the plan-cache + segment-planning suite (ISSUE 9) is the newest
+# subsystem: cache-aliasing and fast-path-divergence regressions
+# surface fast and isolated
+python -m pytest tests/ -q -m 'planner and not slow' -p no:cacheprovider
+
 echo "== failover smoke (marker: failover) =="
 # the replication + failure-detection suite (ISSUE 8) is the newest
 # subsystem: fan-out, detector, promotion, and fencing regressions

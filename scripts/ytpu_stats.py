@@ -34,6 +34,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 GROUPS = (
     ("engine", ("ytpu_engine_", "ytpu_flush")),
     ("native planner", ("ytpu_native_",)),
+    ("planner", ("ytpu_plan_",)),
     ("provider", ("ytpu_provider_",)),
     ("sync", ("ytpu_sync_",)),
     ("network (sessions)", ("ytpu_net_",)),

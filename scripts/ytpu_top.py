@@ -46,6 +46,7 @@ COLUMNS = (
     ("quar", 5),
     ("wal rec", 8),
     ("occup", 6),
+    ("plnhit", 7),
     ("hot", 5),
     ("warm", 5),
     ("cold", 5),
@@ -137,6 +138,14 @@ def collect_row(
         "quar": int(_gauge(snap, "ytpu_resilience_docs_quarantined")),
         "wal rec": int(_counter_sum(snap, "ytpu_wal_records_appended_total")),
         "occup": f"{_gauge(snap, 'ytpu_prof_slot_occupancy'):.2f}",
+        # plan-cache hit rate (process-global counters; "-" before the
+        # first planned flush)
+        "plnhit": (
+            f"{_counter(snap, 'ytpu_plan_cache_hits_total') / _pl:.2f}"
+            if (_pl := _counter(snap, "ytpu_plan_cache_hits_total")
+                + _counter(snap, "ytpu_plan_cache_misses_total"))
+            else "-"
+        ),
         "hot": int(_gauge(snap, "ytpu_tier_docs", "tier=hot")),
         "warm": int(_gauge(snap, "ytpu_tier_docs", "tier=warm")),
         "cold": int(_gauge(snap, "ytpu_tier_docs", "tier=cold")),

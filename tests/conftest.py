@@ -88,6 +88,15 @@ def pytest_configure(config):
         "failover: shard replication, failure detection, and "
         "automatic-failover tests",
     )
+    # "planner" tags the plan-cache + segment-planning suite (ISSUE 9)
+    # — in tier-1 by default (deterministic seeded traces),
+    # deselectable with -m 'not planner'; ci_check.sh also runs it
+    # standalone
+    config.addinivalue_line(
+        "markers",
+        "planner: frontier-keyed plan cache and segment-sorted "
+        "planning tests",
+    )
 
 
 @pytest.fixture

@@ -219,6 +219,14 @@ def load():
         lib._has_add_bufs_many = True
     except AttributeError:
         lib._has_add_bufs_many = False
+    try:
+        # r9: deep state clone — the frontier-keyed plan cache replays a
+        # cached post-prepare mirror state onto another doc's handle
+        lib.ymx_clone_state.restype = ctypes.c_int64
+        lib.ymx_clone_state.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib._has_clone_state = True
+    except AttributeError:
+        lib._has_clone_state = False
     _lib = lib
     return _lib
 

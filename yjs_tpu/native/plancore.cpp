@@ -2623,6 +2623,132 @@ void ymx_prepare_many(void** hs, int64_t n_docs, const int64_t* buf_ofs,
   for (auto& th : pool) th.join();
 }
 
+// deep state clone: dst becomes a bit-identical twin of src — same rows,
+// segments, pending queues, delete sets, AND the same last-prepare plan,
+// so pack_apply / plan readback / encode work on the clone unchanged.
+// Owned arena blocks are deep-copied and every bufs pointer into a
+// src-owned block is remapped to the dst copy; borrowed pointers (the
+// Python-pinned update bytes) are shared, so the caller must keep those
+// buffers alive for the clone's lifetime (the plan cache pins them).
+// Returns an approximate host byte size of the cloned state (cache
+// accounting); dst's previous state is discarded.
+int64_t ymx_clone_state(void* dst_h, void* src_h) {
+  Mirror* d = static_cast<Mirror*>(dst_h);
+  const Mirror* s = static_cast<const Mirror*>(src_h);
+  if (d == s) return 0;
+
+  d->client_of_slot = s->client_of_slot;
+  d->slot_of_client = s->slot_of_client;
+  d->frag_clock = s->frag_clock;
+  d->frag_row = s->frag_row;
+  d->frag_hint = s->frag_hint;
+  d->state = s->state;
+
+  d->r_slot = s->r_slot;
+  d->r_clock = s->r_clock;
+  d->r_len = s->r_len;
+  d->r_oslot = s->r_oslot;
+  d->r_oclock = s->r_oclock;
+  d->r_rslot = s->r_rslot;
+  d->r_rclock = s->r_rclock;
+  d->r_ref = s->r_ref;
+  d->r_seg = s->r_seg;
+  d->r_is_gc = s->r_is_gc;
+  d->r_countable = s->r_countable;
+  d->r_c = s->r_c;
+  d->r_host_deleted = s->r_host_deleted;
+  d->r_lww_deleted = s->r_lww_deleted;
+
+  d->seg_lookup = s->seg_lookup;
+  d->seg_name_id = s->seg_name_id;
+  d->seg_sub_id = s->seg_sub_id;
+  d->seg_parent = s->seg_parent;
+  d->segs_of_parent = s->segs_of_parent;
+  d->rows_of_seg = s->rows_of_seg;
+  d->map_chain = s->map_chain;
+  d->list_next = s->list_next;
+  d->head_of_seg = s->head_of_seg;
+
+  d->strings = s->strings;
+  d->interned = s->interned;
+  d->intern_ofs = s->intern_ofs;
+  d->intern_len = s->intern_len;
+
+  d->ds = s->ds;
+  d->ds_slot_order = s->ds_slot_order;
+  d->pending = s->pending;
+  d->pending_ds = s->pending_ds;
+
+  d->plan = s->plan;
+  d->gen = s->gen;
+  d->dl_mark = s->dl_mark;
+  d->dh_mark = s->dh_mark;
+  d->tm_mark = s->tm_mark;
+  d->dirty_epoch = s->dirty_epoch;
+  d->walk_mark = s->walk_mark;
+  d->walk_order = s->walk_order;
+  d->walk_id = s->walk_id;
+  d->cur_chunk = s->cur_chunk;
+  d->chunk_used = s->chunk_used;
+  for (int i = 0; i < Mirror::kSlotCache; i++) {
+    d->slot_cache_cl[i] = s->slot_cache_cl[i];
+    d->slot_cache_v[i] = s->slot_cache_v[i];
+  }
+  d->slot_cache_pos = s->slot_cache_pos;
+  d->radix_tmp.clear();  // pure scratch: never read before resize
+
+  // owned arena blocks: deep copy, then remap the bufs pointers that
+  // point INTO a src block (arena/arena2 hand out interior pointers for
+  // bump-allocated fragments) onto the dst copy at the same offset
+  d->owned.clear();
+  d->owned.reserve(s->owned.size());
+  struct Range {
+    const uint8_t* lo;
+    const uint8_t* hi;
+    size_t idx;
+  };
+  std::vector<Range> ranges;
+  ranges.reserve(s->owned.size());
+  int64_t owned_bytes = 0;
+  for (size_t i = 0; i < s->owned.size(); i++) {
+    const auto& blk = *s->owned[i];
+    d->owned.push_back(std::make_unique<std::vector<uint8_t>>(blk));
+    owned_bytes += (int64_t)blk.size();
+    if (!blk.empty())
+      ranges.push_back({blk.data(), blk.data() + blk.size(), i});
+  }
+  std::sort(ranges.begin(), ranges.end(),
+            [](const Range& a, const Range& b) { return a.lo < b.lo; });
+  d->bufs = s->bufs;
+  for (auto& [p, n] : d->bufs) {
+    if (p == nullptr || ranges.empty()) continue;
+    // rightmost block starting at or before p (blocks never overlap)
+    auto it = std::upper_bound(
+        ranges.begin(), ranges.end(), p,
+        [](const uint8_t* q, const Range& r) { return q < r.lo; });
+    if (it == ranges.begin()) continue;
+    --it;
+    if (p >= it->lo && p < it->hi)
+      p = d->owned[it->idx]->data() + (p - it->lo);
+  }
+
+  // approximate host footprint (cache eviction accounting): the int64
+  // row/fragment columns dominate real mirrors
+  int64_t bytes = owned_bytes + (int64_t)s->strings.size();
+  bytes += (int64_t)(s->r_slot.size() *
+                     (sizeof(int64_t) * 9 + sizeof(ContentDesc) + 4));
+  bytes += (int64_t)(s->list_next.size() * sizeof(int64_t));
+  for (const auto& fc : s->frag_clock)
+    bytes += (int64_t)(fc.size() * 2 * sizeof(int64_t));
+  bytes += (int64_t)((s->plan.link_rows.size() + s->plan.link_vals.size() +
+                      s->plan.sched.size() * 4 + s->plan.sched8.size() * 8 +
+                      s->plan.levels.size() + s->plan.delete_rows.size()) *
+                     sizeof(int64_t));
+  for (const auto& [cl, q] : s->pending)
+    bytes += (int64_t)(q.size() * sizeof(PendRef));
+  return bytes;
+}
+
 // native twin of BatchEngine._flush_apply's pack loop: bins every doc's
 // plan into the per-shard scatter-lane layout
 //   [4*b_loc counts | k_dn dense vals | k_sp sparse rows | k_sp sparse

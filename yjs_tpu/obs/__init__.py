@@ -55,10 +55,23 @@ FLUSH_METRICS_SCHEMA: dict = {
     "n_pending_docs": 0,
     "pending_depth": 0,
     # worker-pool width the native planner fans per-doc plans out to
-    # (1 = serial / Python planner; YTPU_PLAN_THREADS overrides)
+    # (1 = serial / Python planner; YTPU_PLAN_THREADS overrides).
+    # Reported as the widest pool any prepare batch in this flush
+    # actually used — min(pool width, docs in the batch), not the
+    # configured width.
     "plan_threads": 1,
+    # frontier-keyed plan cache (ISSUE 9): probes served from cache /
+    # planned cold this flush, and structs placed by the segment-sorted
+    # fast path instead of the sequential YATA walk
+    "plan_cache_hits": 0,
+    "plan_cache_misses": 0,
+    "plan_fastpath_structs": 0,
     "t_compact_s": 0.0,
     "t_plan_s": 0.0,
+    # t_plan_s split: snapshot-adoption time for cache hits vs cold
+    # prepare time (t_plan_cached_s + t_plan_cold_s <= t_plan_s)
+    "t_plan_cached_s": 0.0,
+    "t_plan_cold_s": 0.0,
     "t_pack_s": 0.0,
     "t_dispatch_s": 0.0,
     "t_emit_s": 0.0,

@@ -31,6 +31,7 @@ yields the same total order as integrating the whole run and splitting later.
 from __future__ import annotations
 
 import bisect
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -41,6 +42,7 @@ from ..lib0 import decoding
 from ..lib0.binary import BIT6, BIT7, BIT8, BITS5
 from ..lib0.decoding import Decoder
 from ..native import SRC_DELETED, SRC_FRAMED, SRC_NONE, SRC_SPILL, SRC_UTF8
+from . import plan_cache as _pc
 
 NULL = -1  # null id / null row sentinel in every int column
 # sched8 sentinels (shared with the level kernel, yjs_tpu/ops/kernels.py)
@@ -451,6 +453,9 @@ class StepPlan:
     link_vals: list[int] = field(default_factory=list)
     head_segs: list[int] = field(default_factory=list)
     head_vals: list[int] = field(default_factory=list)
+    # structs placed by the segment-sorted conflict-free fast path
+    # instead of the sequential YATA walk (ISSUE 9 accounting)
+    fastpath_structs: int = 0
 
     def assign_levels(self, client_of_row) -> None:
         """Rewrite the causal schedule into the level-parallel bulk form.
@@ -665,6 +670,9 @@ class DocMirror:
         self.ds: dict[int, list[tuple[int, int]]] = {}
         # updates queued since the last flush
         self._incoming: list[tuple[bytes, bool]] = []
+        # plan-cache digest chain (ISSUE 9): advances on every successful
+        # prepare / deterministic compact, poisons on anything else
+        self.plan_frontier = _pc.seed_frontier(root_name)
 
     # -- client slots -------------------------------------------------------
 
@@ -1040,9 +1048,141 @@ class DocMirror:
                 if r != tail and r not in self._lww_deleted:
                     self._delete_row(r, plan)
 
+    def _segment_hints(self, frag_sched):
+        """Segment-sorted anchor pre-resolution (ISSUE 9): batch-resolve
+        every ref's origin/rightOrigin against a post-pre-split snapshot
+        of the fragment index (ONE composed-key searchsorted in
+        ``kernels.plan_anchor_lookup``) and detect intra-batch chains
+        (``kernels.plan_conflict_scan``), replacing up to three per-ref
+        binary searches with O(batch) array ops.
+
+        Returns (hint_left, hint_right, chain_left, chain_right) python
+        lists, or None when disabled/too small.  Hints are verified
+        candidates — a NULL hint falls back to the sequential bisect walk
+        in the caller, so placement can never differ from the slow path.
+        MUST run after the pre-split pass (the snapshot has to include
+        this step's splits) and before any row is added (rows appended
+        mid-loop are resolved by fallback or chain, never the snapshot).
+        """
+        mode = os.environ.get("YTPU_PLAN_SEGMENT", "np")
+        n = len(frag_sched)
+        if mode == "off" or n < 4:
+            return None
+        from . import kernels as _kern  # deferred: kernels imports us
+
+        backend = "jax" if mode == "jax" else "np"
+        client = np.empty(n, np.int64)
+        clock = np.empty(n, np.int64)
+        length = np.empty(n, np.int64)
+        o_cl = np.full(n, -1, np.int64)
+        o_ck = np.zeros(n, np.int64)
+        o_slot = np.full(n, -1, np.int64)
+        r_cl = np.full(n, -1, np.int64)
+        r_ck = np.zeros(n, np.int64)
+        r_slot = np.full(n, -1, np.int64)
+        slot_of = self.slot_of_client.get
+        for j, ref in enumerate(frag_sched):
+            client[j] = ref.client
+            clock[j] = ref.clock
+            length[j] = ref.length
+            if ref.is_gc:
+                continue
+            if ref.origin is not None:
+                c, k = ref.origin
+                o_cl[j] = c
+                o_ck[j] = k
+                s = slot_of(c)
+                if s is not None:
+                    o_slot[j] = s
+            if ref.right_origin is not None:
+                c, k = ref.right_origin
+                r_cl[j] = c
+                r_ck[j] = k
+                s = slot_of(c)
+                if s is not None:
+                    r_slot[j] = s
+        # snapshot of the fragment index, slot-major (per-slot runs are
+        # clock-sorted, so the composed key is globally sorted)
+        sizes = [len(fc) for fc in self.frag_clock]
+        total = sum(sizes)
+        if total:
+            flat_clock = np.concatenate(
+                [np.asarray(fc, np.int64) for fc in self.frag_clock]
+            )
+            flat_row = np.concatenate(
+                [np.asarray(fr, np.int64) for fr in self.frag_row]
+            )
+            flat_slot = np.repeat(
+                np.arange(len(sizes), dtype=np.int64), sizes
+            )
+        else:
+            flat_clock = np.empty(0, np.int64)
+            flat_row = np.empty(0, np.int64)
+            flat_slot = np.empty(0, np.int64)
+        # one lookup for both anchor kinds
+        q_slot = np.concatenate([o_slot, r_slot])
+        q_ck = np.concatenate([o_ck, r_ck])
+        cand = _kern.plan_anchor_lookup(
+            flat_slot, flat_clock, q_slot, q_ck, backend=backend
+        )
+        # verify slot match + containment against the live columns; a
+        # miss (new intra-batch target, degenerate key) yields NULL and
+        # the caller's bisect fallback resolves it
+        row_len = np.asarray(self.row_len, np.int64)
+        safe = np.clip(cand, 0, max(0, total - 1))
+        if total:
+            c_row = flat_row[safe]
+            ok = (
+                (cand >= 0)
+                & (q_slot >= 0)
+                & (flat_slot[safe] == q_slot)
+                & (q_ck >= flat_clock[safe])
+                & (q_ck < flat_clock[safe] + row_len[c_row])
+            )
+            hint = np.where(ok, c_row, NULL)
+        else:
+            hint = np.full(2 * n, NULL, np.int64)
+        chain_l, chain_r, _runs = _kern.plan_conflict_scan(
+            client, clock, length, o_cl, o_ck, r_cl, r_ck,
+            backend=backend,
+        )
+        return (
+            hint[:n].tolist(),
+            hint[n:].tolist(),
+            chain_l.tolist(),
+            chain_r.tolist(),
+        )
+
     # -- the flush pipeline -------------------------------------------------
 
+    def plan_key(self, want_levels: bool | None = None,
+                 want_sched: bool = True):
+        """Plan-cache key for the staged work (ISSUE 9): kind + frontier
+        + staged content digest + plan-shape flag."""
+        return (
+            "p",
+            self.plan_frontier,
+            _pc.staged_digest(self._incoming),
+            want_levels is None or bool(want_levels),
+            True,
+        )
+
     def prepare_step(self, want_levels: bool | None = None) -> StepPlan:
+        """Consume queued updates and produce the device step plan — the
+        cold planning path; advances the plan frontier on success and
+        poisons it on any failure (the mirror may be mid-step then, see
+        the inner docstring)."""
+        sd = _pc.staged_digest(self._incoming)
+        try:
+            plan = self._prepare_step_impl(want_levels)
+        except BaseException:
+            self.plan_frontier = _pc.poison_frontier()
+            _pc.note_invalidation("plan-error")
+            raise
+        self.plan_frontier = _pc.fold(self.plan_frontier, b"u", sd)
+        return plan
+
+    def _prepare_step_impl(self, want_levels: bool | None = None) -> StepPlan:
         """Consume queued updates and produce the device step plan.
 
         ``want_levels=False`` skips the level-parallel schedule (sched8 /
@@ -1197,28 +1337,53 @@ class DocMirror:
         )
 
         # -- row assignment + pointer resolution ---------------------------
+        # segment-sorted anchor hints (ISSUE 9): snapshot + chain masks;
+        # None disables (YTPU_PLAN_SEGMENT=off or a tiny batch)
+        hints = self._segment_hints(frag_sched)
+        if hints is not None:
+            hint_l, hint_r, chain_l, chain_r = hints
+        n_fastpath = 0
+        prev_row = NULL  # row of frag_sched[j-1] (every branch adds one)
         touched_map_segs: set[int] = set()
-        for ref in frag_sched:
+        for j, ref in enumerate(frag_sched):
             slot = self.slot(ref.client)
             if ref.is_gc:
-                self._add_row(slot, ref.clock, ref.length, None, None, True, None)
+                prev_row = self._add_row(
+                    slot, ref.clock, ref.length, None, None, True, None
+                )
                 continue
             left_row = right_row = NULL
             degrade = False
             if ref.origin is not None:
-                oslot = self.slot(ref.origin[0])
-                fi = self._frag_containing(oslot, ref.origin[1])
-                if fi is None:
-                    raise AssertionError("scheduled ref with unresolved origin")
-                left_row = self.frag_row[oslot][fi]
+                if hints is not None:
+                    if chain_l[j] and prev_row != NULL:
+                        left_row = prev_row
+                    else:
+                        left_row = hint_l[j]
+                if left_row == NULL:
+                    oslot = self.slot(ref.origin[0])
+                    fi = self._frag_containing(oslot, ref.origin[1])
+                    if fi is None:
+                        raise AssertionError(
+                            "scheduled ref with unresolved origin"
+                        )
+                    left_row = self.frag_row[oslot][fi]
                 if self.row_is_gc[left_row]:
                     degrade = True  # neighbour was GC'd (Item.js:380-395)
             if ref.right_origin is not None:
-                rslot = self.slot(ref.right_origin[0])
-                fi = self._frag_containing(rslot, ref.right_origin[1])
-                if fi is None:
-                    raise AssertionError("scheduled ref with unresolved rightOrigin")
-                right_row = self.frag_row[rslot][fi]
+                if hints is not None:
+                    if chain_r[j] and prev_row != NULL:
+                        right_row = prev_row
+                    else:
+                        right_row = hint_r[j]
+                if right_row == NULL:
+                    rslot = self.slot(ref.right_origin[0])
+                    fi = self._frag_containing(rslot, ref.right_origin[1])
+                    if fi is None:
+                        raise AssertionError(
+                            "scheduled ref with unresolved rightOrigin"
+                        )
+                    right_row = self.frag_row[rslot][fi]
                 if self.row_is_gc[right_row]:
                     degrade = True
             parent_row = NULL
@@ -1234,7 +1399,9 @@ class DocMirror:
                 ):
                     degrade = True  # parent type was GC'd (Item.js:380-395)
             if degrade:
-                self._add_row(slot, ref.clock, ref.length, None, None, True, None)
+                prev_row = self._add_row(
+                    slot, ref.clock, ref.length, None, None, True, None
+                )
                 continue
             # segment: explicit parent, else copied from the neighbour the
             # wire omitted it for (reference encoding.js canCopyParentInfo)
@@ -1252,8 +1419,32 @@ class DocMirror:
                 slot, ref.clock, ref.length, ref.origin, ref.right_origin, False,
                 ref.content, ref.content_ref, seg=seg,
             )
+            prev_row = row
             plan.sched.append((row, left_row, right_row, seg))
-            actual_left = self._list_insert(seg, row, left_row, right_row, plan)
+            # conflict-free fast splice: when the (left, right) gap is
+            # intact, `_list_insert`'s conflict walk runs zero iterations
+            # — splice inline and skip the call + per-call set churn.
+            # Anything else (concurrent inserts at this gap) falls back
+            # to the sequential YATA walk.
+            nxt = self.list_next
+            if (
+                nxt[left_row] if left_row != NULL else self.head_of_seg[seg]
+            ) == right_row:
+                if left_row != NULL:
+                    nxt[row] = nxt[left_row]
+                    nxt[left_row] = row
+                    plan._dl.update((left_row, row))
+                else:
+                    nxt[row] = self.head_of_seg[seg]
+                    self.head_of_seg[seg] = row
+                    plan._dl.add(row)
+                    plan._dh.add(seg)
+                actual_left = left_row
+                n_fastpath += 1
+            else:
+                actual_left = self._list_insert(
+                    seg, row, left_row, right_row, plan
+                )
             if self.seg_is_map(seg):
                 chain = self.map_chain.setdefault(seg, [])
                 if actual_left == NULL:
@@ -1290,6 +1481,8 @@ class DocMirror:
 
         self._lww_pass(touched_map_segs, plan)
         plan.n_rows = self.n_rows
+        plan.fastpath_structs = n_fastpath
+        _pc.note_fastpath(n_fastpath)
         if want_levels is None or want_levels:
             plan.assign_levels(self._row_client)
         # finalize the bulk-apply deltas: FINAL values after all splices
@@ -1460,6 +1653,17 @@ class DocMirror:
                 prev = nr
         self.list_next = new_right.tolist()
         self.head_of_seg = new_heads[: self.n_segs].tolist()
+        # deterministic fold over the compaction inputs: same inputs ->
+        # same chain, anything else diverges (plan-cache keying)
+        self.plan_frontier = _pc.fold(
+            self.plan_frontier,
+            b"compact",
+            np.ascontiguousarray(right_link, np.int32).tobytes()
+            + np.ascontiguousarray(deleted, np.uint8).tobytes()
+            + np.ascontiguousarray(head_of_seg, np.int32).tobytes()
+            + (b"g" if gc else b"-"),
+        )
+        _pc.note_invalidation("compact")
         return new_right, new_deleted, new_heads
 
     def _renumber(self, keep: list[int], new_of_old: np.ndarray) -> None:

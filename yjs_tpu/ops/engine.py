@@ -16,6 +16,7 @@ from __future__ import annotations
 import contextlib
 import os
 import time
+from types import SimpleNamespace
 
 import numpy as np
 
@@ -35,6 +36,7 @@ from ..resilience import DeadLetterQueue, HealthTracker
 from ..updates import InvalidUpdate, validate_update
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
+from . import plan_cache
 from .native_mirror import (
     NativeMirror,
     native_plan_available,
@@ -478,6 +480,7 @@ class BatchEngine:
                 )
         self.fallback[doc] = fb
         self.mirrors[doc] = DocMirror(self.root_name)  # dead mirror
+        plan_cache.note_invalidation("demote")
         self._update_log[doc] = []
         self._uploaded_rows[doc] = 0
         if self._update_listeners:
@@ -885,6 +888,7 @@ class BatchEngine:
         decides whether the slot's letters travel with the evicted
         doc)."""
         self.mirrors[doc] = make_mirror(self.root_name)
+        plan_cache.note_invalidation("reset")
         self.fallback.pop(doc, None)
         self._pending_hydration.pop(doc, None)
         self._update_log[doc] = []
@@ -964,6 +968,8 @@ class BatchEngine:
         pre_svs: dict[int, dict[int, int]] = {}
         demoted_now = 0
         rolled_back = 0
+        cache_hits = cache_misses = 0
+        t_plan_cached = t_plan_cold = 0.0
         emitting = bool(self._update_listeners)
         observing = self._event_listeners
         # kernel selection: "apply" (default, meshed or not) ships the
@@ -997,6 +1003,7 @@ class BatchEngine:
                     work.append((i, m))
                 plans = dict(work)  # presence for the empty-flush check
             else:
+                cache = plan_cache.get_cache()
                 for i, m in enumerate(self.mirrors):
                     if i in self.fallback:
                         continue
@@ -1004,6 +1011,25 @@ class BatchEngine:
                         continue  # idle doc: nothing to plan, upload, or emit
                     if emitting or i in observing:
                         pre_svs[i] = m.state_vector()
+                    key = ent = None
+                    if cache is not None:
+                        key = m.plan_key(want_levels)
+                        ent = cache.lookup(key)
+                    t_d0 = time.perf_counter()
+                    if ent is not None:
+                        # hit: replay the cached post-prepare snapshot
+                        # onto this mirror instead of re-planning
+                        if isinstance(m, NativeMirror):
+                            plans[i] = m.make_plan(m.adopt_cached(ent))
+                        else:
+                            m2, plans[i] = ent.clone()
+                            # keep the mirror's object identity (engine
+                            # internals and tests may hold references)
+                            m.__dict__.clear()
+                            m.__dict__.update(m2.__dict__)
+                        cache_hits += 1
+                        t_plan_cached += time.perf_counter() - t_d0
+                        continue
                     try:
                         plans[i] = m.prepare_step(want_levels=want_levels)
                     except UnsupportedUpdate as e:
@@ -1018,6 +1044,14 @@ class BatchEngine:
                         self._isolate_failure(i, e, pre_svs.get(i))
                         demoted_now += 1
                         rolled_back += 1
+                    else:
+                        if key is not None:
+                            cache_misses += 1
+                            if isinstance(m, NativeMirror):
+                                cache.insert_native(key, m, plans[i].counts)
+                            else:
+                                cache.insert_py(key, m, plans[i])
+                    t_plan_cold += time.perf_counter() - t_d0
         t_plan = time.perf_counter()
         # ONE schema (obs.FLUSH_METRICS_SCHEMA) for every exit: each path
         # overwrites only the fields it measures, so the key set cannot
@@ -1028,6 +1062,15 @@ class BatchEngine:
             n_fallback_docs=len(self.fallback),
             t_compact_s=t_compact - t_start,
             t_plan_s=t_plan - t_compact,
+            t_plan_cached_s=t_plan_cached,
+            t_plan_cold_s=t_plan_cold,
+            plan_cache_hits=cache_hits,
+            plan_cache_misses=cache_misses,
+            plan_fastpath_structs=sum(
+                getattr(p, "fastpath_structs", 0) or 0
+                for p in plans.values()
+                if p is not None and not isinstance(p, NativeMirror)
+            ),
         )
         if not plans:
             metrics["t_total_s"] = time.perf_counter() - t_start
@@ -1258,36 +1301,137 @@ class BatchEngine:
         demoted_now = metrics["n_demoted"]
         rolled_back = metrics["n_rolled_back"]
         max_rows_all = 0
+        cache = plan_cache.get_cache()
+        # events read plan.sched; skip building it otherwise
+        want_sched = bool(self._event_listeners)
+        cache_hits = cache_misses = 0
+        t_cached_acc = t_cold_acc = 0.0
+        cfg_threads = _native_plan_threads()
+        plan_threads_used = 1
         for c0 in range(0, len(work), chunk_sz):
             chunk = work[c0 : c0 + chunk_sz]
             t0 = time.perf_counter()
             with self._phase_ctx("plan", chunk=c0 // chunk_sz,
                                  docs=len(chunk)):
-                counts_all, rcs, staged_info = prepare_many(
-                    chunk,
-                    want_levels=False,
-                    # events read plan.sched; skip building it otherwise
-                    want_sched=bool(self._event_listeners),
-                    obs=self.obs,
-                )
                 chunk_ok: list = []
-                for k, (i, m) in enumerate(chunk):
-                    try:
-                        m._finish_prepare(
-                            int(rcs[k]), staged_info[k][0], staged_info[k][1],
-                            counts_all[k],
-                        )
-                    except UnsupportedUpdate as e:
-                        self._demote(i, pre_svs.get(i), reason=str(e))
-                        demoted_now += 1
-                    except Exception as e:
-                        if self._strict:
-                            raise
-                        self._isolate_failure(i, e, pre_svs.get(i))
-                        demoted_now += 1
-                        rolled_back += 1
-                    else:
-                        chunk_ok.append((i, m, counts_all[k]))
+                hits: list = []    # (doc, mirror, entry)
+                cold: list = []    # (doc, mirror, key) — group leaders
+                groups: dict = {}  # key -> trailing same-key members
+                if cache is not None:
+                    for i, m in chunk:
+                        key = m.plan_key(False, want_sched)
+                        g = groups.get(key)
+                        if g is not None:
+                            # intra-chunk duplicate (broadcast fan-out):
+                            # cloned from the leader after it plans
+                            g.append((i, m))
+                            continue
+                        ent = cache.lookup(key)
+                        if ent is not None:
+                            hits.append((i, m, ent))
+                        else:
+                            groups[key] = []
+                            cold.append((i, m, key))
+                else:
+                    cold = [(i, m, None) for i, m in chunk]
+                th0 = time.perf_counter()
+                for i, m, ent in hits:
+                    chunk_ok.append((i, m, m.adopt_cached(ent)))
+                cache_hits += len(hits)
+                t_cached_acc += time.perf_counter() - th0
+                retry: list = []  # members whose leader failed
+                if cold:
+                    tc0 = time.perf_counter()
+                    cache_misses += len(cold)
+                    plan_threads_used = max(
+                        plan_threads_used, min(cfg_threads, len(cold))
+                    )
+                    counts_all, rcs, staged_info = prepare_many(
+                        [(i, m) for i, m, _k in cold],
+                        want_levels=False,
+                        want_sched=want_sched,
+                        obs=self.obs,
+                    )
+                    for k, (i, m, key) in enumerate(cold):
+                        try:
+                            m._finish_prepare(
+                                int(rcs[k]), staged_info[k][0],
+                                staged_info[k][1], counts_all[k],
+                            )
+                        except UnsupportedUpdate as e:
+                            self._demote(i, pre_svs.get(i), reason=str(e))
+                            demoted_now += 1
+                            retry.extend(groups.get(key, ()))
+                        except Exception as e:
+                            if self._strict:
+                                raise
+                            self._isolate_failure(i, e, pre_svs.get(i))
+                            demoted_now += 1
+                            rolled_back += 1
+                            retry.extend(groups.get(key, ()))
+                        else:
+                            chunk_ok.append((i, m, counts_all[k]))
+                            members = groups.get(key)
+                            if members:
+                                # identical frontier + staged bytes plan
+                                # identically: clone the leader's live
+                                # post-prepare state instead of
+                                # re-walking each member
+                                th1 = time.perf_counter()
+                                src = SimpleNamespace(
+                                    h=m._h,
+                                    counts=counts_all[k],
+                                    pins=m._py_bufs,
+                                    frontier_after=m.plan_frontier,
+                                )
+                                for j, mj in members:
+                                    chunk_ok.append(
+                                        (j, mj, mj.adopt_cached(src))
+                                    )
+                                cache_hits += len(members)
+                                plan_cache.note_hits(len(members))
+                                t_cached_acc += time.perf_counter() - th1
+                            if key is not None:
+                                # post-prepare, pre-pack: the snapshot a
+                                # future hit adopts before running the
+                                # pack/dispatch phases itself
+                                cache.insert_native(key, m, counts_all[k])
+                    t_cold_acc += time.perf_counter() - tc0
+                if retry:
+                    # a leader's demote/isolate says nothing about its
+                    # members under the per-doc error policy — plan each
+                    # individually, exactly as a cache-off flush would
+                    tc0 = time.perf_counter()
+                    cache_misses += len(retry)
+                    plan_cache.note_misses(len(retry))
+                    plan_threads_used = max(
+                        plan_threads_used, min(cfg_threads, len(retry))
+                    )
+                    counts2, rcs2, staged2 = prepare_many(
+                        retry, want_levels=False, want_sched=want_sched,
+                        obs=self.obs,
+                    )
+                    for k, (i, m) in enumerate(retry):
+                        try:
+                            m._finish_prepare(
+                                int(rcs2[k]), staged2[k][0], staged2[k][1],
+                                counts2[k],
+                            )
+                        except UnsupportedUpdate as e:
+                            self._demote(i, pre_svs.get(i), reason=str(e))
+                            demoted_now += 1
+                        except Exception as e:
+                            if self._strict:
+                                raise
+                            self._isolate_failure(i, e, pre_svs.get(i))
+                            demoted_now += 1
+                            rolled_back += 1
+                        else:
+                            chunk_ok.append((i, m, counts2[k]))
+                    t_cold_acc += time.perf_counter() - tc0
+                # hit/leader/member completion order is cache-dependent;
+                # pack and emit must see the same doc order either way
+                chunk_ok.sort(key=lambda t: t[0])
             t1 = time.perf_counter()
             t_plan_acc += t1 - t0
             if not chunk_ok:
@@ -1389,13 +1533,18 @@ class BatchEngine:
             "n_pending_docs": int(pending_mask.sum()),
             "pending_depth": int(counts[pending_mask, 9].sum()),
             "t_plan_s": t_plan_acc,
+            "t_plan_cached_s": t_cached_acc,
+            "t_plan_cold_s": t_cold_acc,
+            "plan_cache_hits": cache_hits,
+            "plan_cache_misses": cache_misses,
             "t_pack_s": t_pack_acc,
             "t_dispatch_s": t_disp_acc,
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
-            # worker-pool width the native planner fanned per-doc plans
-            # out to (1 = serial; YTPU_PLAN_THREADS overrides)
-            "plan_threads": _native_plan_threads(),
+            # widest worker pool any prepare batch in this flush actually
+            # used — min(configured width, docs in the batch); 1 when
+            # every doc was served from the plan cache
+            "plan_threads": plan_threads_used,
         })
         self._finish_flush(metrics)
 

@@ -26,6 +26,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..obs.prof import profiled
@@ -473,6 +474,114 @@ def apply_plan_shared(dyn, lanes, k_l, k_h, k_d):
     )
     deleted = deleted.at[:, dels].set(True, mode="drop", unique_indices=True)
     return right_link, deleted, starts
+
+
+# ---------------------------------------------------------------------------
+# segment-sorted planning kernels (ISSUE 9)
+# ---------------------------------------------------------------------------
+# The host planner's per-struct cost is anchor resolution: three binary
+# searches per ref against the per-client fragment index.  These kernels
+# hoist that into sorted-segment array ops over the whole flush batch:
+#
+# - `plan_anchor_lookup`: ONE searchsorted over the slot-major
+#   concatenated fragment index resolves every ref's origin/rightOrigin
+#   candidate at once (the composed (slot, clock) key trick — per-slot
+#   runs are clock-sorted, so slot*B+clock is globally sorted);
+# - `plan_conflict_scan`: adjacent-ref chain detection — a ref whose
+#   origin (or rightOrigin) lands inside the PREVIOUS ref's id range
+#   chains onto it (typing runs, prepend runs), so its anchor is the
+#   previous ref's row with no index lookup at all.  `run_id` numbers the
+#   maximal chained runs (cumsum over chain breaks).
+#
+# Hints are *candidates*, not answers: the planner verifies containment
+# against the live columns and falls back to the sequential bisect walk
+# on any miss, so a wrong hint can never change placement.  Both kernels
+# have NumPy twins (the default host path, YTPU_PLAN_SEGMENT=np) and
+# jitted JAX versions (YTPU_PLAN_SEGMENT=jax) whose retraces/compiles the
+# kernel profiler attributes like any other device kernel.
+
+
+def _compose_keys(flat_slot, flat_clock, q_slot, q_clock):
+    """(slot, clock) pairs -> one sortable int64 key space; invalid
+    queries (slot < 0) map below every real key."""
+    base = int(max(flat_clock.max() if flat_clock.size else 0,
+                   q_clock.max() if q_clock.size else 0)) + 2
+    flat_key = flat_slot * base + flat_clock
+    q_key = np.where(q_slot >= 0, q_slot * base + q_clock, -1)
+    return flat_key, q_key
+
+
+@profiled("plan_anchor_lookup")
+@jax.jit
+def _anchor_lookup_jax(flat_key, q_key):
+    return jnp.searchsorted(flat_key, q_key, side="right") - 1
+
+
+def plan_anchor_lookup(flat_slot, flat_clock, q_slot, q_clock,
+                       backend: str = "np"):
+    """Candidate fragment-index position for each (q_slot, q_clock): the
+    last fragment starting at or before the queried clock, or -1.  The
+    caller must verify slot match + containment before trusting it."""
+    flat_key, q_key = _compose_keys(flat_slot, flat_clock, q_slot, q_clock)
+    if backend == "jax":
+        return np.asarray(_anchor_lookup_jax(flat_key, q_key))
+    return np.searchsorted(flat_key, q_key, side="right") - 1
+
+
+@profiled("plan_conflict_scan")
+@jax.jit
+def _conflict_scan_jax(client, clock, length, o_client, o_clock,
+                       r_client, r_clock):
+    p_client, p_clock = client[:-1], clock[:-1]
+    p_end = p_clock + length[:-1]
+    left = (
+        (o_client[1:] == p_client)
+        & (o_client[1:] >= 0)
+        & (o_clock[1:] >= p_clock)
+        & (o_clock[1:] < p_end)
+    )
+    right = (
+        (r_client[1:] == p_client)
+        & (r_client[1:] >= 0)
+        & (r_clock[1:] >= p_clock)
+        & (r_clock[1:] < p_end)
+    )
+    pad = jnp.zeros(1, bool)
+    left = jnp.concatenate([pad, left])
+    right = jnp.concatenate([pad, right])
+    run_id = jnp.cumsum(~(left | right))
+    return left, right, run_id
+
+
+def plan_conflict_scan(client, clock, length, o_client, o_clock,
+                       r_client, r_clock, backend: str = "np"):
+    """Chain masks over a clock-sorted flush batch: ``left[j]`` /
+    ``right[j]`` mean ref j's origin / rightOrigin lies inside ref j-1's
+    id range (so its anchor row IS ref j-1's row); ``run_id`` groups the
+    maximal chained (conflict-free) runs."""
+    if backend == "jax":
+        l, r, g = _conflict_scan_jax(
+            client, clock, length, o_client, o_clock, r_client, r_clock
+        )
+        return np.asarray(l), np.asarray(r), np.asarray(g)
+    p_client, p_clock = client[:-1], clock[:-1]
+    p_end = p_clock + length[:-1]
+    left = np.zeros(len(client), bool)
+    right = np.zeros(len(client), bool)
+    left[1:] = (
+        (o_client[1:] == p_client)
+        & (o_client[1:] >= 0)
+        & (o_clock[1:] >= p_clock)
+        & (o_clock[1:] < p_end)
+    )
+    right[1:] = (
+        (r_client[1:] == p_client)
+        & (r_client[1:] >= 0)
+        & (r_clock[1:] >= p_clock)
+        & (r_clock[1:] < p_end)
+    )
+    run_id = np.cumsum(~(left | right))
+    return left, right, run_id
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,7 @@ from .columns import (
     UnsupportedUpdate,
     decode_update_refs,
 )
+from . import plan_cache as _pc
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i32p = ctypes.POINTER(ctypes.c_int32)
@@ -82,6 +83,9 @@ class NativePlan:
         (self.n_rows, n_splits, n_sched, self._n_s8, self.n_levels,
          self.max_width, n_del, self._n_ads) = (int(x) for x in counts[:8])
         n_links, n_heads = int(counts[12]), int(counts[13])
+        # full counts row retained for the plan cache (insert after a
+        # cold per-doc prepare needs it)
+        self.counts = np.array(counts, np.int64, copy=True)
         self._lib, self._h = lib, h
         # staleness guard for lazy sections: the C++ plan buffers are
         # overwritten by the mirror's next prepare
@@ -201,6 +205,9 @@ class NativeMirror:
         # mirrors counts[8] of the last prepare: lets the engine skip the
         # per-doc ymx_has_pending call when binning flush work
         self._had_pending = False
+        # plan-cache digest chain (ISSUE 9): advances on every successful
+        # prepare / deterministic compact, poisons on anything else
+        self.plan_frontier = _pc.seed_frontier(root_name)
         # extra per-row source columns the shadow DocMirror has no slot for
         self._src_ofs2: list[int] = []
         self._src_end2: list[int] = []
@@ -236,6 +243,37 @@ class NativeMirror:
             v2s[j] = 1 if v2 else 0
         return staged, ids, v2s
 
+    def plan_key(self, want_levels: bool, want_sched: bool = True):
+        """Plan-cache key for the staged work: kind + frontier + staged
+        content digest + plan-shape flags (the flags change the cloned
+        ``plan`` member, not the integrated state)."""
+        return (
+            "n",
+            self.plan_frontier,
+            _pc.staged_digest(self._incoming),
+            bool(want_levels),
+            bool(want_sched),
+        )
+
+    def adopt_cached(self, entry) -> np.ndarray:
+        """Replay a cached post-prepare snapshot onto this doc's handle
+        instead of planning: one deep state clone, then the same
+        bookkeeping a real prepare would do.  ``entry`` is anything with
+        ``h`` (source handle), ``counts``, ``pins`` and
+        ``frontier_after`` — a cache entry or a just-planned leader
+        mirror wrapped by the engine."""
+        self._lib.ymx_clone_state(self._h, entry.h)
+        self._incoming = []
+        self._plan_seq += 1
+        self._had_pending = bool(entry.counts[8])
+        # the clone's borrowed buffer pointers reference the source's
+        # pinned update payloads; share the pins to keep them alive
+        self._py_bufs = dict(entry.pins)
+        self._realized.clear()
+        self._synced_gen = -1  # force a full shadow rebuild on next _sync
+        self.plan_frontier = entry.frontier_after
+        return np.array(entry.counts, np.int64, copy=True)
+
     def _finish_prepare(self, rc, staged, ids, counts) -> None:
         """Post-prepare bookkeeping shared by the per-doc and batched
         paths; raises exactly like the old inline prepare_step body."""
@@ -244,6 +282,12 @@ class NativeMirror:
         self._incoming = []
         self._plan_seq += 1
         self._had_pending = bool(counts[8])
+        if rc != 0:
+            # the core may have merged a prefix before failing — this
+            # state is not a deterministic function of the digest chain,
+            # so no other mirror may ever alias it
+            self.plan_frontier = _pc.poison_frontier()
+            _pc.note_invalidation("plan-error")
         if rc == -9:
             raise UnsupportedUpdate("subdocument (content ref 9)")
         if rc != 0:
@@ -265,6 +309,9 @@ class NativeMirror:
                 raise
             raise UnsupportedUpdate(f"native plan: unsupported payload (rc={rc})")
         self._realized.clear()
+        self.plan_frontier = _pc.fold(
+            self.plan_frontier, b"u", _pc.staged_digest(staged)
+        )
 
     def make_plan(self, counts) -> NativePlan:
         """Wrap the core's current plan (valid until the next prepare)."""
@@ -415,6 +462,13 @@ class NativeMirror:
                 len(new_heads),
             )
             self._realized.clear()
+            # compaction-from-self is a pure function of state already in
+            # the chain: a deterministic fold, so two docs compacted at
+            # the same point keep aliasing each other's cache entries
+            self.plan_frontier = _pc.fold(
+                self.plan_frontier, b"compact-self", b"g" if gc else b"-"
+            )
+            _pc.note_invalidation("compact")
             return (
                 new_right[:n_new],
                 new_del[:n_new].astype(bool),
@@ -452,6 +506,15 @@ class NativeMirror:
             new_del.ctypes.data_as(_u8p), _p32(new_heads), len(new_heads),
         )
         self._realized.clear()
+        # link/deleted/head inputs come from the caller, so fold their
+        # content in: same inputs -> same chain, anything else diverges
+        self.plan_frontier = _pc.fold(
+            self.plan_frontier,
+            b"compact",
+            right.tobytes() + dele.tobytes() + heads.tobytes()
+            + (b"g" if gc else b"-"),
+        )
+        _pc.note_invalidation("compact")
         return (
             new_right[:n_new],
             new_del[:n_new].astype(bool),
