@@ -1270,6 +1270,85 @@ def bench_failover() -> dict:
     return out
 
 
+def bench_overload() -> dict:
+    """Admission + brownout under multi-tenant overload (ISSUE 10): a
+    replicated fleet takes a seeded mixed-profile population (editors,
+    idlers, a reconnector, a lossy link, direct abusive writers) offered
+    at >= 2x its sustained admission capacity.  The brownout controller
+    is expected to climb (shed-background -> coalesce -> reject-writes),
+    shed the surplus via the weighted-fair queue and typed rejections,
+    and return to normal within a bounded number of ticks once the load
+    stops.  The contract alongside the numbers: zero acked-update loss
+    (every room byte-identical between the client replica and the
+    fleet), the interactive SLO never pages while background traffic
+    sheds, and no session needs more than its one initial full resync.
+
+    The block is also written to BENCH_overload.json.
+    """
+    import tempfile
+
+    from yjs_tpu.admission import AdmissionConfig
+    from yjs_tpu.fleet import FleetRouter
+    from yjs_tpu.loadgen import LoadGen, LoadGenConfig
+    from yjs_tpu.persistence import WalConfig
+
+    n_shards = int(os.environ.get("YTPU_BENCH_OVERLOAD_SHARDS", "3"))
+    n_clients = int(os.environ.get("YTPU_BENCH_OVERLOAD_CLIENTS", "12"))
+    ticks = int(os.environ.get("YTPU_BENCH_OVERLOAD_TICKS", "150"))
+    seed = int(os.environ.get("YTPU_BENCH_OVERLOAD_SEED", "7"))
+    adm_cfg = AdmissionConfig(
+        enabled=True, tenant_rate=0.5, tenant_burst=2,
+        doc_rate=0.5, doc_burst=2, queue_max=16, drain_batch=4,
+        up_ticks=2, down_ticks=6,
+    )
+    with tempfile.TemporaryDirectory(prefix="ytpu-bench-ov") as wd:
+        fleet = FleetRouter(
+            n_shards, 32, wal_dir=wd,
+            wal_config=WalConfig(fsync="never"),
+            admission_config=adm_cfg,
+        )
+        lg = LoadGen(fleet, LoadGenConfig(
+            seed=seed, n_clients=n_clients, flush_every=8,
+        ))
+        t0 = time.perf_counter()
+        lg.run(ticks)
+        lg.drain()
+        wall_s = time.perf_counter() - t0
+        rep = lg.report()
+        adm = rep["admission"]
+        out = {
+            "n_shards": n_shards,
+            "n_clients": n_clients,
+            "ticks": rep["ticks"],
+            "seed": seed,
+            "wall_s": round(wall_s, 3),
+            "overload_factor": rep["overload_factor"],
+            "offered_updates": adm["offered"],
+            "admitted": adm["admitted"],
+            "queued": adm["queued"],
+            "drained": adm["drained"],
+            "rejected": adm["rejected"],
+            "shed_fraction": rep["shed_fraction"],
+            "reject_rate": rep["reject_rate"],
+            "interactive_p99_ticks": rep["interactive_p99_ticks"],
+            "slo_page_ticks": rep["slo_page_ticks"],
+            "max_brownout_level": rep["max_level"],
+            "brownout_transitions": len(rep["transitions"]),
+            "recovery_ticks": rep["recovery_ticks"],
+            "convergence_failures": len(rep["convergence_failures"]),
+            "max_full_resyncs_per_session": max(
+                [0, *rep["session_full_resyncs"]]
+            ),
+        }
+        fleet.close(checkpoint=False)
+    try:
+        with open("BENCH_overload.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def bench_tiering(n_ops: int = 200) -> dict:
     """Tiered doc-lifecycle cost (ISSUE 7), three parts:
 
@@ -1493,6 +1572,8 @@ def main():
     time.sleep(3)
     failover = bench_failover()
     time.sleep(3)
+    overload = bench_overload()
+    time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
         prefix = os.environ.get("YTPU_BENCH_OBS_PREFIX", "BENCH_obs")
@@ -1558,6 +1639,7 @@ def main():
             "fleet": fleet,
             "tiering": tiering,
             "failover": failover,
+            "overload": overload,
         },
     }
     if sweep is not None:

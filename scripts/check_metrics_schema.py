@@ -9,7 +9,7 @@ surface cannot drift apart.  Also cross-checks the resilience/chaos/
 durability/profiling/network/fleet env knobs (``YTPU_CHAOS_*`` /
 ``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` /
 ``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*`` / ``YTPU_FLEET_*`` /
-``YTPU_TIER_*``)
+``YTPU_TIER_*`` / ``YTPU_ADM_*``)
 read by the code against the knobs README documents.  Wired as a tier-1
 check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
 runnable standalone:
@@ -54,7 +54,7 @@ def registered_names() -> set[str]:
 
 _KNOB_RE = re.compile(
     r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET|TIER|REPL"
-    r"|FAILOVER|PLAN)_[A-Z0-9_]+"
+    r"|FAILOVER|PLAN|ADM)_[A-Z0-9_]+"
 )
 
 

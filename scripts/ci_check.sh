@@ -10,6 +10,17 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== admission smoke (marker: admission) =="
+# the rate-limit + brownout suite (ISSUE 10) is the newest subsystem:
+# bucket/fair-queue, hysteresis, and BUSY-backpressure regressions
+# surface fast and isolated
+python -m pytest tests/ -q -m 'admission and not slow' -p no:cacheprovider
+
+echo "== overload harness smoke (marker: loadgen) =="
+# the seeded multi-tenant overload harness (ISSUE 10): acked-loss /
+# convergence / SLO-protection invariants under >2x offered load
+python -m pytest tests/ -q -m 'loadgen and not slow' -p no:cacheprovider
+
 echo "== planner smoke (marker: planner) =="
 # the plan-cache + segment-planning suite (ISSUE 9) is the newest
 # subsystem: cache-aliasing and fast-path-divergence regressions

@@ -45,6 +45,7 @@ GROUPS = (
     ("convergence SLO", ("ytpu_convergence_", "ytpu_slo_")),
     ("tiering", ("ytpu_tier_",)),
     ("replication", ("ytpu_repl_", "ytpu_failover_")),
+    ("admission", ("ytpu_adm_",)),
 )
 
 

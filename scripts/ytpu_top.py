@@ -50,6 +50,7 @@ COLUMNS = (
     ("hot", 5),
     ("warm", 5),
     ("cold", 5),
+    ("brownout", 9),
 )
 
 # per-shard fleet rows (rendered when a snapshot carries a "fleet"
@@ -88,6 +89,9 @@ SESSION_COLUMNS = (
 )
 
 _STATE_NAMES = {0: "ok", 1: "warning", 2: "page"}
+
+# brownout degradation levels, abbreviated to fit the column
+_ADM_NAMES = {0: "normal", 1: "shed-bg", 2: "coalesce", 3: "rej-write"}
 
 
 def _counter(snap: dict, name: str, labels_key: str = "") -> float:
@@ -149,6 +153,13 @@ def collect_row(
         "hot": int(_gauge(snap, "ytpu_tier_docs", "tier=hot")),
         "warm": int(_gauge(snap, "ytpu_tier_docs", "tier=warm")),
         "cold": int(_gauge(snap, "ytpu_tier_docs", "tier=cold")),
+        "brownout": (
+            "off"
+            if not (snap.get("admission") or {}).get("enabled")
+            else _ADM_NAMES.get(
+                int((snap.get("admission") or {}).get("level", 0)), "?"
+            )
+        ),
         "sessions": [
             {
                 "provider": name,

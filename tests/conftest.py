@@ -97,6 +97,47 @@ def pytest_configure(config):
         "planner: frontier-keyed plan cache and segment-sorted "
         "planning tests",
     )
+    # "admission" tags the rate-limit + brownout suite (ISSUE 10) — in
+    # tier-1 by default (tick-deterministic controller, tmp-dir WALs),
+    # deselectable with -m 'not admission'; ci_check.sh also runs it
+    # standalone
+    config.addinivalue_line(
+        "markers",
+        "admission: token-bucket rate limits, weighted-fair queuing, "
+        "and brownout degradation tests",
+    )
+    # "loadgen" tags the multi-tenant overload-harness suite (ISSUE 10)
+    # — in tier-1 by default (seeded tick-deterministic load), it is
+    # the slowest of the marker suites, deselectable with
+    # -m 'not loadgen'
+    config.addinivalue_line(
+        "markers",
+        "loadgen: seeded multi-tenant overload harness tests",
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On failure, surface the deterministic seeds a test ran with so
+    the exact chaos/loadgen schedule can be replayed from the report
+    alone (the seeds live in fixtures/attributes, not the traceback)."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    seeds = {}
+    env_seed = os.environ.get("YTPU_TEST_SEED")
+    if env_seed is not None:
+        seeds["YTPU_TEST_SEED"] = env_seed
+    for attr in ("chaos_seed", "loadgen_seed", "seed"):
+        v = getattr(item, attr, None)
+        if v is not None:
+            seeds[attr] = v
+    if seeds:
+        report.sections.append((
+            "deterministic seeds",
+            " ".join(f"{k}={v}" for k, v in sorted(seeds.items())),
+        ))
 
 
 @pytest.fixture

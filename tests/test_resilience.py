@@ -255,7 +255,9 @@ def test_replay_revalidates():
     eng = BatchEngine(1)
     eng.dead_letters.append(0, b"\xff\xff", False, "quarantined")
     res = eng.replay_dead_letters(doc=0, readmit=True)
-    assert res == {"replayed": 0, "requeued": 0, "failed": 1}
+    assert res == {
+        "replayed": 0, "requeued": 0, "failed": 1, "truncated": 0,
+    }
     letters = eng.dead_letters.list(doc=0)
     assert len(letters) == 1
     assert letters[0].reason.startswith("replay-invalid:")

@@ -41,9 +41,15 @@ import os
 import time
 from pathlib import Path
 
+from ..admission import AdmissionController
 from ..obs import global_registry
-from ..provider import TpuProvider
-from ..sync.session import SessionConfig, SessionMetrics, SyncSession
+from ..provider import ProviderFullError, TpuProvider
+from ..sync.session import (
+    SessionConfig,
+    SessionMetrics,
+    SyncSession,
+    encode_busy,
+)
 from .hashring import (
     FleetFullError,
     HashRing,
@@ -194,15 +200,44 @@ class _FleetSessionHost:
         self.fleet.receive_update(self.guid, update)
 
     def handle_frame(self, frame: bytes) -> bytes | None:
-        return self.fleet._handle_frame_routed(self.guid, frame)
+        fleet = self.fleet
+        try:
+            return fleet._handle_frame_routed(self.guid, frame)
+        except (ProviderFullError, FleetFullError) as e:
+            # Capacity exhaustion must not escape into the transport
+            # pump: feed the admission controller (brownout signal +
+            # tiering headroom), keep the bytes as replicated typed
+            # dead-letter evidence, push back on the peer with BUSY.
+            kind = "fleet" if isinstance(e, FleetFullError) else "provider"
+            fleet.admission.note_full(kind)
+            full_reason = f"admission-full: {e} (peer {self.peer})"
+            fleet.repl.enqueue_dlq(
+                self.guid, bytes(frame), False, full_reason
+            )
+            own = fleet.owner_of(self.guid)
+            if own is not None and not fleet._is_stub(own):
+                try:
+                    fleet.shards[own].engine._dead_letter(
+                        -1, bytes(frame), False, full_reason
+                    )
+                except ShardDownError:
+                    fleet.detector.report_down(own)
+            return encode_busy(fleet.admission.retry_after)
 
     def dead_letter(self, payload: bytes, reason: str) -> None:
         full_reason = f"{reason} (peer {self.peer})"
         try:
             p = self._prov()
+            try:
+                doc = p.doc_id(self.guid)
+            except ProviderFullError:
+                self.fleet.admission.note_full("provider")
+                doc = -1
             p.engine._dead_letter(
-                p.doc_id(self.guid), bytes(payload), False, full_reason,
+                doc, bytes(payload), False, full_reason,
             )
+        except FleetFullError:
+            self.fleet.admission.note_full("fleet")
         except ShardDownError:
             own = self.fleet.owner_of(self.guid)
             if own is not None:
@@ -246,8 +281,15 @@ class FleetRouter:
         tier_config=None,
         repl_config=None,
         failover_config=None,
+        admission_config=None,
     ):
         self.config = config if config is not None else FleetConfig()
+        # ONE admission controller shared by every shard: per-tenant
+        # buckets and the brownout level are fleet-wide, and the fleet
+        # tick drives the clock (claim_ticker below)
+        self.admission = AdmissionController(
+            admission_config, registry=registry
+        )
         self._root_name = root_name
         self._gc = gc
         self._backend = backend
@@ -285,6 +327,7 @@ class FleetRouter:
                     wal_dir=self._shard_wal_dir(k),
                     wal_config=wal_config,
                     tier_config=tier_config,
+                    admission=self.admission,
                 )
                 for k in range(n_shards)
             ]
@@ -316,6 +359,13 @@ class FleetRouter:
         for k, prov in enumerate(self.shards):
             prov.shard_id = k
             self._attach_bridge(k, prov)
+            # externally-built providers (recover(), tests) arrive with
+            # private controllers: rebind them onto the shared one
+            if prov.admission is not self.admission:
+                prov.admission.detach(prov)
+                prov.admission = self.admission
+            self.admission.attach(prov)
+        self.admission.claim_ticker(self)
         self.failover_metrics = FailoverMetrics(self.metrics.registry)
         self.detector = FailureDetector(
             range(len(self.shards)),
@@ -465,18 +515,20 @@ class FleetRouter:
 
     def receive_update(
         self, guid: str, update: bytes, v2: bool = False,
-        undoable: bool = False,
+        undoable: bool = False, internal: bool = False,
     ) -> bool:
         """Queue one room update on its owning shard.  Inside a
         migration window the update is double-delivered (source AND
         destination journal + integrate it); the CRDT merge is
         idempotent, so the duplicate is free and the handoff can never
-        drop an in-flight edit."""
+        drop an in-flight edit.  ``internal`` marks fleet-generated
+        traffic (migration/failover/recovery state transfers) that must
+        bypass admission control — it was already admitted once."""
         mig = self._migrating.get(guid)
         k = self.shard_of(guid)
         try:
             accepted = self.shards[k].receive_update(
-                guid, update, v2=v2, undoable=undoable
+                guid, update, v2=v2, undoable=undoable, internal=internal
             )
         except ShardDownError:
             # the primary's machine is gone but the detector hasn't
@@ -492,8 +544,10 @@ class FleetRouter:
                 self.repl.enqueue_update(guid, update, v2=v2)
         if mig is not None:
             try:
+                # the primary already admitted this update; re-gating
+                # the duplicate would double-charge the tenant's bucket
                 self.shards[mig["dst"]].receive_update(
-                    guid, update, v2=v2
+                    guid, update, v2=v2, internal=True
                 )
                 self.metrics.double_delivered.inc()
             except ShardDownError:
@@ -619,6 +673,7 @@ class FleetRouter:
         hint = prov._recovered_acks.get(key)
         if hint is not None:
             sess.set_resume_hint(*hint)
+        sess.policy = self.admission
         sess.routing_epoch = self.table.epoch
         self._sessions[key] = sess
         return sess
@@ -688,7 +743,7 @@ class FleetRouter:
         src_p.journal_migration(guid, dst, self.table.epoch)
         src_p.flush()
         state = src_p.encode_state_as_update(guid)
-        dst_p.receive_update(guid, state)
+        dst_p.receive_update(guid, state, internal=True)
         self._migrating[guid] = {
             "src": src, "dst": dst, "reason": reason, "t0": t0,
         }
@@ -708,7 +763,7 @@ class FleetRouter:
             guid, self.shards[src].tiers.heat_of(guid)
         )
         final = self.shards[src].release_doc(guid)
-        self.shards[dst].receive_update(guid, final)
+        self.shards[dst].receive_update(guid, final, internal=True)
         del self._migrating[guid]
         self.table.assign(guid, dst)
         epoch = self.table.bump()
@@ -797,6 +852,7 @@ class FleetRouter:
             wal_dir=self._shard_wal_dir(k),
             wal_config=self._wal_config,
             tier_config=self._tier_config,
+            admission=self.admission,
         )
         prov.shard_id = k
         self.shards.append(prov)
@@ -815,6 +871,7 @@ class FleetRouter:
         drain, then a rebalancer pass.  Returns the rebalance
         decisions."""
         self.tick_sessions()
+        self.admission.tick()
         for k, _old, new in self.detector.tick(self._probe):
             if new == "dead":
                 self.fail_over(k)
@@ -854,6 +911,9 @@ class FleetRouter:
         prov = self.shards[shard]
         if prov.wal is not None:
             prov.wal.abandon()
+        # its queued admission entries die with it — they were journaled
+        # + replicated at enqueue, so failover recovers them
+        self.admission.detach(prov)
         self._corpses[shard] = prov
         self.shards[shard] = DeadShard(shard)
 
@@ -879,6 +939,7 @@ class FleetRouter:
             wal_dir=self._shard_wal_dir(shard),
             wal_config=self._wal_config,
             tier_config=self._tier_config,
+            admission=self.admission,
         )
         fresh.shard_id = shard
         self.shards[shard] = fresh
@@ -901,10 +962,10 @@ class FleetRouter:
             if own is None:
                 # failover declared it lost (no replica existed): the
                 # corpse's copy is the only one — re-place it fresh
-                self.receive_update(guid, state)
+                self.receive_update(guid, state, internal=True)
                 readopted.append(guid)
             elif own != shard:
-                self.shards[own].receive_update(guid, state)
+                self.shards[own].receive_update(guid, state, internal=True)
                 self.failover_metrics.fenced.inc()
                 fenced.append(guid)
         epoch = self.table.bump()
@@ -993,6 +1054,7 @@ class FleetRouter:
             "capacity": self.capacity,
             "migrations_active": len(self._migrating),
             "replication": self.repl.snapshot(),
+            "admission": self.admission.snapshot(),
             "shards": rows,
         }
 
@@ -1116,7 +1178,7 @@ class FleetRouter:
                     # final export (it may hold a tail the destination
                     # missed), then release
                     final = p.release_doc(guid)
-                    shards[dst].receive_update(guid, final)
+                    shards[dst].receive_update(guid, final, internal=True)
                     fleet.metrics.migrations.labels(
                         reason="recovery-complete"
                     ).inc()
@@ -1175,7 +1237,7 @@ class FleetRouter:
                 # (CRDT-idempotent merge: a tail only the loser held is
                 # recovered, shared state dedupes)
                 final = shards[k].release_doc(guid)
-                shards[owner].receive_update(guid, final)
+                shards[owner].receive_update(guid, final, internal=True)
                 if role == "replica":
                     reason = "recovery-replica"
                     resolved["replicas_folded"] += 1

@@ -241,6 +241,11 @@ class EngineObs:
             "ytpu_resilience_replayed_total",
             "Dead letters successfully re-integrated by replay()",
         )
+        self._replay_truncated = r.counter(
+            "ytpu_resilience_dlq_replay_truncated_total",
+            "Matching dead letters left queued by the per-invocation "
+            "replay batch cap (YTPU_DLQ_REPLAY_BATCH)",
+        )
         # device-memory cost attribution (ISSUE 4): refreshed once per
         # flush from the engine's persistent device buffers
         self._device_table_bytes = r.gauge(
@@ -348,6 +353,11 @@ class EngineObs:
         if not self.enabled or n <= 0:
             return
         self._replayed.inc(n)
+
+    def replay_truncated(self, n: int) -> None:
+        if not self.enabled or n <= 0:
+            return
+        self._replay_truncated.inc(n)
 
     # -- exposition ----------------------------------------------------
 

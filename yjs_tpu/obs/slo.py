@@ -327,6 +327,14 @@ class ConvergenceTracker:
         self._burn["long"].set(burns["long"])
         self._m_state.set(_STATE_CODES[state])
 
+    def state(self) -> str:
+        """Current burn-rate verdict (``ok``/``warning``/``page``),
+        re-evaluated so aged-out windows decay — cheap enough for the
+        admission controller to poll every tick."""
+        if self.enabled and self._events:
+            self._update_state()
+        return self._state
+
     def snapshot(self) -> dict:
         """JSON-able SLO state (served as ``provider.slo_snapshot()``)."""
         if self.enabled and self._events:

@@ -531,7 +531,7 @@ class BatchEngine:
 
     def replay_dead_letters(
         self, doc: int | None = None, seqs=None, repair=None,
-        readmit: bool = False,
+        readmit: bool = False, max_letters: int | None = None,
     ) -> dict:
         """Re-inject dead letters through the normal ingestion path.
 
@@ -541,11 +541,29 @@ class BatchEngine:
         clears the targeted docs' health records first (operator
         override of quarantine backoff).  Letters that still fail
         validation or admission are re-dead-lettered and counted as
-        failed.  Returns ``{"replayed", "requeued", "failed"}``."""
+        failed.  Work per invocation is bounded: at most ``max_letters``
+        (``YTPU_DLQ_REPLAY_BATCH``, default 256; 0 = unbounded) letters
+        are taken, the rest stay queued and are reported as
+        ``truncated`` (metered by
+        ``ytpu_resilience_dlq_replay_truncated_total``) so a deep DLQ
+        cannot stall a flush tick or an admission drain.  Returns
+        ``{"replayed", "requeued", "failed", "truncated"}``."""
         if readmit:
             self.health.reset(doc)
+        if max_letters is None:
+            try:
+                max_letters = int(
+                    os.environ.get("YTPU_DLQ_REPLAY_BATCH", "256")
+                )
+            except ValueError:
+                max_letters = 256
+        cap = max_letters if max_letters and max_letters > 0 else None
         replayed = requeued = failed = 0
-        for e in self.dead_letters.take(doc=doc, seqs=seqs):
+        truncated = 0
+        if cap is not None:
+            matching = self.dead_letters.count_matching(doc=doc, seqs=seqs)
+            truncated = max(0, matching - cap)
+        for e in self.dead_letters.take(doc=doc, seqs=seqs, limit=cap):
             update = e.update
             if repair is not None:
                 fixed = repair(e)
@@ -565,7 +583,14 @@ class BatchEngine:
             else:
                 failed += 1  # inadmissible: re-dead-lettered by queue_update
         self.obs.replayed(replayed)
-        return {"replayed": replayed, "requeued": requeued, "failed": failed}
+        if truncated:
+            self.obs.replay_truncated(truncated)
+        return {
+            "replayed": replayed,
+            "requeued": requeued,
+            "failed": failed,
+            "truncated": truncated,
+        }
 
     def resilience_snapshot(self) -> dict:
         """JSON-able view of the failure-isolation state (bench/expo)."""

@@ -27,6 +27,7 @@ from .records import (
     FLAG_V2,
     HEADER_SIZE,
     KIND_ACK,
+    KIND_ADM,
     KIND_DLQ,
     KIND_MIGRATE,
     KIND_NAMES,
@@ -64,6 +65,7 @@ __all__ = [
     "FLAG_V2",
     "HEADER_SIZE",
     "KIND_ACK",
+    "KIND_ADM",
     "KIND_DLQ",
     "KIND_MIGRATE",
     "KIND_NAMES",

@@ -8,7 +8,7 @@ record format so a single reader serves segments and checkpoints alike:
     record header (14 B, little-endian):
         magic        u16    0x7EA1
         kind         u8     1=update 2=snapshot 3=dlq 4=release 5=ack
-                            6=migrate 7=tier
+                            6=migrate 7=tier 8=repl 9=adm
         flags        u8     bit0 = payload uses the V2 update encoding
         guid_len     u16
         payload_len  u32
@@ -63,6 +63,14 @@ KIND_TIER = 7
 # ownership without treating replica journals as split-brain owners,
 # and to fence a stale primary's claim behind a newer promotion epoch.
 KIND_REPL = 8
+# admission brownout transition (ISSUE 10): journaled on every attached
+# provider's WAL when the fleet brownout controller changes degradation
+# level, so a post-incident recovery can reconstruct exactly when and
+# why service was degraded.  Guid is empty (the record is fleet-scoped,
+# not doc-scoped); payload is JSON {"level": name, "reason": str,
+# "tick": controller_tick}.  Recovery surfaces a count and the last
+# level in its stats; the live level always restarts at "normal".
+KIND_ADM = 9
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
@@ -72,6 +80,7 @@ KIND_NAMES = {
     KIND_MIGRATE: "migrate",
     KIND_TIER: "tier",
     KIND_REPL: "repl",
+    KIND_ADM: "adm",
 }
 
 FLAG_V2 = 1

@@ -25,6 +25,7 @@ from pathlib import Path
 
 from .records import (
     KIND_ACK,
+    KIND_ADM,
     KIND_DLQ,
     KIND_MIGRATE,
     KIND_RELEASE,
@@ -105,7 +106,11 @@ def count_guids(path, exclude_from: int | None = None) -> int:
     sources = ([ckpt[1]] if ckpt else []) + [p for _, p in segs]
     for j, p in enumerate(sources):
         for ev in iter_file_events(p, final=(j == len(sources) - 1)):
-            if ev[0] == "record" and ev[1].kind != KIND_DLQ:
+            if ev[0] == "record" and ev[1].kind not in (
+                KIND_DLQ, KIND_ADM
+            ):
+                # KIND_ADM records are fleet-scoped (empty guid) and
+                # must not inflate the recovered fleet size
                 guids.add(ev[1].guid)
     return len(guids)
 
@@ -143,6 +148,8 @@ def replay_wal(
         "migrations_pending": {},
         "repl_markers": 0,
         "repl_roles": {},
+        "adm_transitions": 0,
+        "adm_level": None,
         "tier_records": 0,
         "tier_placements": {},
         "corrupt_records": 0,
@@ -374,6 +381,19 @@ def replay_wal(
                     else:
                         stats["repl_markers"] += 1
                         m.replayed.labels(disposition="repl").inc()
+            elif rec.kind == KIND_ADM:
+                # brownout transition marker (ISSUE 10): forensic record
+                # of when/why service degraded.  Surfaced in stats only;
+                # the live brownout level always restarts at "normal"
+                # (post-crash load may look nothing like pre-crash).
+                try:
+                    info = json.loads(rec.payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    info = None
+                if isinstance(info, dict) and "level" in info:
+                    stats["adm_transitions"] += 1
+                    stats["adm_level"] = str(info["level"])
+                    m.replayed.labels(disposition="adm").inc()
             elif rec.kind == KIND_ACK:
                 # session ack floor (ISSUE 5): the journaled "we hold
                 # peer session <sid> up to <seq>" fact.  Later records

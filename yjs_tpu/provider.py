@@ -17,6 +17,7 @@ import base64
 import json
 import os
 
+from .admission import AdmissionController, AdmissionRejected
 from .lib0.decoding import Decoder
 from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
@@ -24,6 +25,7 @@ from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
     KIND_ACK,
+    KIND_ADM,
     KIND_DLQ,
     KIND_MIGRATE,
     KIND_RELEASE,
@@ -34,7 +36,12 @@ from .persistence import (
     WriteAheadLog,
 )
 from .sync import protocol
-from .sync.session import SessionConfig, SessionMetrics, SyncSession
+from .sync.session import (
+    SessionConfig,
+    SessionMetrics,
+    SyncSession,
+    encode_busy,
+)
 from .tiering import TierManager
 from .updates import validate_update
 
@@ -75,12 +82,31 @@ class _ProviderSessionHost:
         self.provider.receive_update(self.guid, update)
 
     def handle_frame(self, frame: bytes) -> bytes | None:
-        return self.provider.handle_sync_message(self.guid, frame)
+        p = self.provider
+        try:
+            return p.handle_sync_message(self.guid, frame)
+        except ProviderFullError as e:
+            # Capacity exhaustion is an overload condition, not a
+            # transport fault: record it for the admission controller
+            # (which demotes cold docs to make headroom), keep the bytes
+            # in the DLQ with a typed reason, and push back on the peer
+            # instead of letting the error escape into its pump loop.
+            p.admission.note_full("provider")
+            p.engine._dead_letter(
+                -1, bytes(frame), False,
+                f"admission-full: {e} (peer {self.peer})",
+            )
+            return encode_busy(p.admission.retry_after)
 
     def dead_letter(self, payload: bytes, reason: str) -> None:
         p = self.provider
+        try:
+            doc = p.doc_id(self.guid)
+        except ProviderFullError:
+            p.admission.note_full("provider")
+            doc = -1
         p.engine._dead_letter(
-            p.doc_id(self.guid), bytes(payload), False,
+            doc, bytes(payload), False,
             f"{reason} (peer {self.peer})",
         )
 
@@ -121,6 +147,8 @@ class TpuProvider:
         wal_dir=None,
         wal_config: WalConfig | None = None,
         tier_config=None,
+        admission: AdmissionController | None = None,
+        admission_config=None,
     ):
         self.backend = backend
         self.engine = BatchEngine(
@@ -221,6 +249,17 @@ class TpuProvider:
         # auto-eviction / promotion only activate when the config says
         # enabled — default-off keeps the hard ProviderFullError cap
         self.tiers = TierManager(self, tier_config)
+        # admission control + brownout (ISSUE 10): a FLEET injects one
+        # shared controller into every shard (fleet-wide tenant buckets
+        # and one brownout level); standalone providers get a private
+        # one.  Families register unconditionally; default-off config
+        # keeps every seam check to a single attribute read.
+        self.admission = (
+            admission
+            if admission is not None
+            else AdmissionController(admission_config, registry=r)
+        )
+        self.admission.attach(self)
 
     # -- doc management -----------------------------------------------------
 
@@ -337,7 +376,7 @@ class TpuProvider:
 
     def receive_update(
         self, guid: str, update: bytes, v2: bool = False,
-        undoable: bool = False,
+        undoable: bool = False, internal: bool = False,
     ) -> bool:
         """Queue one room update.  ``undoable=True`` marks it for the
         room's undo stack when :meth:`enable_undo` is active (the server
@@ -348,7 +387,36 @@ class TpuProvider:
         diverted to the engine's dead-letter queue instead (the room is
         quarantined, or a CPU-served apply failed) — recoverable via
         :meth:`replay_dead_letters`; the undo replica is only fed
-        accepted updates so it cannot diverge from the room."""
+        accepted updates so it cannot diverge from the room.
+
+        With admission control enabled (ISSUE 10) the update passes the
+        per-tenant/per-doc token buckets first: over-rate traffic is
+        journaled and parked in the weighted-fair queue (still True —
+        it WILL integrate, on a later flush drain), and a rejected
+        update raises the typed
+        :class:`~yjs_tpu.admission.AdmissionRejected` before any state
+        changes — internal traffic (migration, failover, recovery)
+        bypasses the gate with ``internal=True``."""
+        adm = self.admission
+        verdict = "admit"
+        if adm.enabled and not internal:
+            # gate BEFORE doc_id: a rejected writer must not allocate a
+            # slot, and a queued update takes its slot at drain time
+            verdict = adm.admit_update(self, guid, len(update))
+        if verdict == "queue":
+            if self.wal is not None:
+                # journaled at ENQUEUE: the queue is host memory, and
+                # zero acked-update loss must hold across a crash.  SLO
+                # bookkeeping waits for the drain — queue age is traffic
+                # the controller chose to shed, and letting it page the
+                # interactive SLO would feed the brownout its own
+                # shedding as an overload signal (self-sustaining
+                # degradation, the flap hysteresis exists to prevent)
+                self.wal.append(KIND_UPDATE, guid, update, v2=v2)
+            self._m_updates_rx.inc()
+            self._m_ingress_bytes.inc(len(update))
+            adm.enqueue(self, guid, bytes(update), v2, undoable, None)
+            return True
         doc = self.doc_id(guid)
         with self.engine.obs.tracer.span(
             "ytpu.provider.receive_update", guid=guid
@@ -371,6 +439,34 @@ class TpuProvider:
             if ru is not None:
                 ru.apply_update(update, tracked=undoable, v2=v2)
             return True
+
+    def _integrate_admitted(
+        self, guid: str, update: bytes, v2: bool, undoable: bool, slo_key
+    ) -> bool:
+        """Integrate one update popped from the admission queue.  The
+        update was journaled at enqueue; it enters the SLO window only
+        now (``slo_key=None``), so shed traffic's queue age is invisible
+        to the interactive convergence verdict."""
+        if slo_key is None:
+            slo_key = self.slo.receive(update, v2=v2, guid=guid)
+        try:
+            doc = self.doc_id(guid)
+        except ProviderFullError as e:
+            self.admission.note_full("provider")
+            self.slo.rejected(slo_key)
+            self.engine._dead_letter(
+                -1, update, v2, f"admission-full: {e}"
+            )
+            return False
+        if not self.engine.queue_update(doc, update, v2=v2):
+            self.slo.rejected(slo_key)
+            return False
+        self.slo.integrated(slo_key)
+        self._dirty = True
+        ru = self._undo.get(guid)
+        if ru is not None:
+            ru.apply_update(update, tracked=undoable, v2=v2)
+        return True
 
     # -- server-side undo ---------------------------------------------------
 
@@ -469,6 +565,11 @@ class TpuProvider:
         exists (not just on the flush that demoted it): the demoted docs
         stay served by the CPU core so no data is lost, but the operator
         is alerted on every flush until they act."""
+        adm = self.admission
+        if adm.enabled:
+            # integrate queued over-rate traffic first (weighted-fair,
+            # bounded batch) so it rides this flush's device step
+            adm.drain_for(self)
         if self._dirty:
             # reset BEFORE the engine call and restore only if it fails:
             # raising after the engine integrated (as the device-policy
@@ -566,6 +667,24 @@ class TpuProvider:
                 )
                 return None
             self._m_ingress_bytes.inc(len(u))
+            adm = self.admission
+            if adm.enabled:
+                # the admission seam for session DATA / plain update
+                # frames: a veto becomes a BUSY/retry-after envelope
+                # reply (enhanced peers back off and coalesce; plain
+                # y-protocols readers skip it) — never a silent drop
+                try:
+                    verdict = adm.admit_update(self, guid, len(u))
+                except AdmissionRejected as e:
+                    self._m_sync_msgs.labels(type="rejected").inc()
+                    return encode_busy(e.retry_after)
+                if verdict == "queue":
+                    # journaled now (durability), SLO-received at drain
+                    # (shed traffic must not page the interactive SLO)
+                    if self.wal is not None:
+                        self.wal.append(KIND_UPDATE, guid, u)
+                    adm.enqueue(self, guid, bytes(u), False, False, None)
+                    return None
             key = self.slo.receive(u, guid=guid)
             if self.wal is not None:
                 # journal the PAYLOAD, post-validation: transport damage
@@ -675,6 +794,9 @@ class TpuProvider:
         hint = self._recovered_acks.get(key)
         if hint is not None:
             sess.set_resume_hint(*hint)
+        # sessions read the live brownout flags (coalesce, anti-entropy
+        # pause) straight off the controller every tick
+        sess.policy = self.admission
         self._sessions[key] = sess
         return sess
 
@@ -686,7 +808,10 @@ class TpuProvider:
 
     def tick_sessions(self) -> None:
         """One session-time tick for every peer session (retransmit
-        backoff, heartbeats, liveness, anti-entropy) + gauge refresh."""
+        backoff, heartbeats, liveness, anti-entropy) + gauge refresh.
+        Also advances the admission/brownout clock when this provider
+        owns it (a fleet claims the tick for itself)."""
+        self.admission.maybe_tick(self)
         for sess in list(self._sessions.values()):
             sess.tick()
         self._session_metrics.set_state_gauges(self._sessions.values())
@@ -752,6 +877,22 @@ class TpuProvider:
             KIND_REPL, guid,
             json.dumps(info, separators=(",", ":")).encode("utf-8"),
         )
+
+    def journal_admission(
+        self, level: str, reason: str, tick: int
+    ) -> None:
+        """Journal a brownout level transition (KIND_ADM): "the
+        admission controller entered ``level`` at controller tick
+        ``tick`` because ``reason``".  Fleet-scoped (empty guid);
+        recovery surfaces a count and the last level for forensics —
+        the live level always restarts at normal."""
+        if self.wal is None:
+            return
+        payload = json.dumps(
+            {"level": str(level), "reason": str(reason), "tick": int(tick)},
+            separators=(",", ":"),
+        ).encode("utf-8")
+        self.wal.append(KIND_ADM, "", payload)
 
     def journal_replica_record(
         self, kind: int, guid: str, payload: bytes, v2: bool = False
@@ -965,6 +1106,7 @@ class TpuProvider:
         snap["slo"] = self.slo.snapshot()
         snap["sessions"] = self.sessions_snapshot()
         snap["tiers"] = tiers
+        snap["admission"] = self.admission.snapshot()
         return snap
 
     def slo_snapshot(self) -> dict:
@@ -1022,7 +1164,7 @@ class TpuProvider:
 
     def replay_dead_letters(
         self, guid: str | None = None, seqs=None, repair=None,
-        readmit: bool = True,
+        readmit: bool = True, max_letters: int | None = None,
     ) -> dict:
         """Re-inject dead letters (one room, or all) through the normal
         ingestion path after a fix — see
@@ -1047,7 +1189,8 @@ class TpuProvider:
                 return fixed
 
         res = self.engine.replay_dead_letters(
-            doc=doc, seqs=seqs, repair=repair, readmit=readmit
+            doc=doc, seqs=seqs, repair=repair, readmit=readmit,
+            max_letters=max_letters,
         )
         if res["replayed"]:
             self._dirty = True
@@ -1235,6 +1378,7 @@ class TpuProvider:
         backend: str = "auto",
         wal_config: WalConfig | None = None,
         tier_config=None,
+        admission_config=None,
     ) -> "TpuProvider":
         """Rebuild a provider from a crashed predecessor's WAL directory.
 
@@ -1259,6 +1403,7 @@ class TpuProvider:
             wal_dir=path,
             wal_config=wal_config,
             tier_config=tier_config,
+            admission_config=admission_config,
         )
         prov.last_recovery = replay_wal(
             prov, path, exclude_from=prov.wal.first_index

@@ -93,25 +93,40 @@ class DeadLetterQueue:
         return [e for e in self._q if e.doc == doc]
 
     def take(
-        self, doc: int | None = None, seqs=None
+        self, doc: int | None = None, seqs=None, limit: int | None = None
     ) -> list[DeadLetter]:
         """Remove and return matching letters (oldest-first).
 
         ``doc`` restricts to one doc; ``seqs`` (an iterable of letter
         seq ids) restricts to specific letters.  Both None = drain all.
+        ``limit`` caps how many matches are taken — excess matches stay
+        queued (oldest taken first), so one replay invocation cannot
+        stall a flush tick on an arbitrarily deep queue.
         """
         seq_set = None if seqs is None else set(seqs)
         taken: list[DeadLetter] = []
         kept: deque[DeadLetter] = deque()
         for e in self._q:
-            if (doc is None or e.doc == doc) and (
-                seq_set is None or e.seq in seq_set
+            if (
+                (doc is None or e.doc == doc)
+                and (seq_set is None or e.seq in seq_set)
+                and (limit is None or len(taken) < limit)
             ):
                 taken.append(e)
             else:
                 kept.append(e)
         self._q = kept
         return taken
+
+    def count_matching(self, doc: int | None = None, seqs=None) -> int:
+        """Letters a ``take`` with the same filters would match."""
+        seq_set = None if seqs is None else set(seqs)
+        return sum(
+            1
+            for e in self._q
+            if (doc is None or e.doc == doc)
+            and (seq_set is None or e.seq in seq_set)
+        )
 
     def snapshot(self, letters: bool = False) -> dict:
         """JSON-able summary for exposition/bench artifacts.

@@ -79,6 +79,12 @@ K_ACK = 3
 K_PING = 4
 K_PONG = 5
 K_DIGEST = 6
+# cooperative backpressure (ISSUE 10): "back off for N ticks".  Sent
+# when a session enters lagging (before more frames would be shed) and
+# as the admission layer's reply to a rejected write.  Plain
+# y-protocols peers skip the whole envelope; enhanced peers coalesce
+# their sends into one pending delta until the window passes.
+K_BUSY = 7
 
 _KIND_NAMES = {
     K_HELLO: "hello",
@@ -88,6 +94,7 @@ _KIND_NAMES = {
     K_PING: "ping",
     K_PONG: "pong",
     K_DIGEST: "digest",
+    K_BUSY: "busy",
 }
 
 CONNECTING = "connecting"
@@ -106,6 +113,18 @@ _SID = itertools.count(1)
 # an empty V1 update (0 client struct-lists + empty delete set) — a
 # diff at or below this size carries nothing and is not worth a frame
 _EMPTY_UPDATE_LEN = 2
+
+
+def encode_busy(retry_after: int) -> bytes:
+    """One BUSY envelope frame: ``121 | K_BUSY | varint retry_after``.
+    Module-level (not a session method) because the provider's
+    admission seam emits it as a ``handle_sync_message`` reply without
+    owning a session object."""
+    enc = Encoder()
+    encoding.write_var_uint(enc, MESSAGE_YTPU_SESSION)
+    encoding.write_var_uint(enc, K_BUSY)
+    encoding.write_var_uint(enc, max(1, int(retry_after)))
+    return enc.to_bytes()
 
 
 def _env_int(name: str, default: int, lo: int = 0,
@@ -148,11 +167,15 @@ class SessionConfig:
     - ``hello_timeout``: ticks in ``connecting`` before falling back to
       a bare plain-protocol step 1 for peers that never initiate
       (``YTPU_NET_HELLO_TIMEOUT`` default 4; 0 disables).
+    - ``busy_retry``: retry-after ticks carried by the BUSY frame a
+      lagging session sends before shedding more frames
+      (``YTPU_NET_BUSY_RETRY`` default 4; 0 disables sending — BUSY
+      frames are still honored on receive).
     """
 
     __slots__ = ("retry_base", "retry_cap", "retry_max", "retry_jitter",
                  "outbox_high", "outbox_low", "heartbeat", "liveness",
-                 "antientropy", "hello_timeout", "seed")
+                 "antientropy", "hello_timeout", "busy_retry", "seed")
 
     def __init__(
         self,
@@ -166,6 +189,7 @@ class SessionConfig:
         liveness: int | None = None,
         antientropy: int | None = None,
         hello_timeout: int | None = None,
+        busy_retry: int | None = None,
         seed: int = 0,
     ):
         def pick(v, name, default, lo=0):
@@ -187,6 +211,7 @@ class SessionConfig:
         self.hello_timeout = pick(
             hello_timeout, "YTPU_NET_HELLO_TIMEOUT", 4
         )
+        self.busy_retry = pick(busy_retry, "YTPU_NET_BUSY_RETRY", 4)
         self.seed = seed
 
     def as_dict(self) -> dict:
@@ -270,6 +295,11 @@ class SessionMetrics:
             "ytpu_net_outbox_depth",
             "Deepest per-peer outbox across the session fleet "
             "(refreshed on tick/snapshot)",
+        )
+        self.busy_backoffs = r.counter(
+            "ytpu_net_busy_backoffs_total",
+            "BUSY/retry-after frames honored (sends coalesced until "
+            "the advertised window passed)",
         )
 
     def set_state_gauges(self, sessions) -> None:
@@ -364,6 +394,14 @@ class SyncSession:
         self._send_seq = 0
         self._outbox: list[dict] = []
         self._pending_delta = False
+
+        # admission policy (ISSUE 10): the owning provider/fleet's
+        # AdmissionController, read dynamically for its brownout flags
+        # (force_coalesce, antientropy_paused); None for client-side
+        # sessions.  _busy_until is the peer-advertised backoff window.
+        self.policy = None
+        self._busy_until = 0
+        self.n_busy_backoffs = 0
 
         # receive side: cumulative ack + out-of-order window
         self._peer_sid = 0
@@ -519,6 +557,17 @@ class SyncSession:
         self.metrics.rounds.inc()
         self._send_frame(enc.to_bytes(), "digest")
 
+    def _send_busy(self, retry_after: int) -> None:
+        self._send_frame(encode_busy(retry_after), "busy")
+
+    def _on_busy(self, dec: Decoder) -> None:
+        retry = decoding.read_var_uint(dec)
+        until = self._tick + max(1, int(retry))
+        if until > self._busy_until:
+            self._busy_until = until
+        self.n_busy_backoffs += 1
+        self.metrics.busy_backoffs.inc()
+
     def _data_frame(self, seq: int, inner: bytes) -> bytes:
         enc = self._envelope(K_DATA)
         encoding.write_var_uint(enc, seq)
@@ -570,6 +619,17 @@ class SyncSession:
             self.n_coalesced += 1
             self.metrics.coalesced.inc()
             return
+        pol = self.policy
+        if self._tick < self._busy_until or (
+            pol is not None and getattr(pol, "force_coalesce", False)
+        ):
+            # peer asked us to back off (BUSY) or the brownout level
+            # forces lagging-style coalescing: fold into the pending
+            # delta, flushed by tick() once the window allows
+            self._pending_delta = True
+            self.n_coalesced += 1
+            self.metrics.coalesced.inc()
+            return
         if self.state == LAGGING or len(self._outbox) >= self.config.outbox_high:
             self._enter_lagging()
             self._pending_delta = True
@@ -583,6 +643,19 @@ class SyncSession:
     def _enter_lagging(self) -> None:
         if self.state == LAGGING:
             return
+        # cooperative backpressure first: tell the peer to back off
+        # BEFORE frames start shedding, so a well-behaved sender
+        # coalesces at its end instead of flooding a lagging link.
+        # Gated on an admission policy being live — without one the
+        # wire behavior is exactly the pre-ISSUE-10 protocol.
+        pol = self.policy
+        if (
+            self.config.busy_retry
+            and not self.plain_mode
+            and pol is not None
+            and getattr(pol, "enabled", False)
+        ):
+            self._send_busy(self.config.busy_retry)
         # shed queued-but-never-sent frames: the coalesced delta
         # supersedes them (sent-once frames stay for ack accounting —
         # the peer may already hold them)
@@ -603,6 +676,8 @@ class SyncSession:
             return
         if self.state not in (LIVE, LAGGING):
             return
+        if self._tick < self._busy_until:
+            return  # peer asked us to hold off; tick() flushes later
         if len(self._outbox) > self.config.outbox_low:
             return
         self._pending_delta = False
@@ -714,6 +789,15 @@ class SyncSession:
             return
         self.n_received += 1
         reply = self.host.handle_frame(bytes(inner))
+        if reply is not None and reply[0] == MESSAGE_YTPU_SESSION:
+            # an envelope reply (admission BUSY) means the host REFUSED
+            # this frame — it was neither applied nor journaled.  Leave
+            # the seq un-acked so the peer keeps it in its outbox and
+            # retransmits once its backoff expires; acking a rejected
+            # update would silently lose it.
+            self.n_received -= 1
+            self._send_frame(reply, "busy")
+            return
         self._recv_seen.add(seq)
         while (self._recv_cum + 1) in self._recv_seen:
             self._recv_cum += 1
@@ -739,6 +823,12 @@ class SyncSession:
     def _on_digest(self, dec: Decoder) -> None:
         peer_sv = decoding.read_var_uint8_array(dec)
         self._peer_sv = peer_sv
+        pol = self.policy
+        if pol is not None and getattr(pol, "antientropy_paused", False):
+            # shed-background: answering repairs is exactly the
+            # expensive diff work this level exists to shed; the peer's
+            # own digest loop retries once the brownout lifts
+            return
         mine = decode_state_vector(self.host.state_vector())
         theirs = decode_state_vector(bytes(peer_sv))
         ahead = any(
@@ -800,6 +890,8 @@ class SyncSession:
                 self.metrics.heartbeats.labels(dir="recv").inc()
             elif kind == K_DIGEST:
                 self._on_digest(dec)
+            elif kind == K_BUSY:
+                self._on_busy(dec)
             # unknown envelope kinds: a newer revision — skip (the
             # same tolerance contract as the plain frame reader)
         except Exception as e:
@@ -834,8 +926,11 @@ class SyncSession:
                 self._count_handshake(False)
                 self._set_state(LIVE)
         elif reply is not None:
-            # an enhanced peer sent a stray bare frame: answer in kind
-            self._queue_data(reply)
+            if reply[0] == MESSAGE_YTPU_SESSION:
+                self._send_frame(reply, "busy")
+            else:
+                # enhanced peer sent a stray bare frame: answer in kind
+                self._queue_data(reply)
 
     # -- the clock -----------------------------------------------------------
 
@@ -869,8 +964,15 @@ class SyncSession:
         # envelope, so over-sending never hurts interop)
         if self.state == CONNECTING and self._tick >= self._next_hello:
             self._send_hello()
-        # retransmission with exponential backoff + jitter
-        if self.state in (SYNCING, LIVE, LAGGING) and self._outbox:
+        # retransmission with exponential backoff + jitter; a BUSY
+        # window pauses the whole pass (attempts included) — the server
+        # asked us to hold, so burning the retry budget against its
+        # admission gate would dead-letter frames it WILL take later
+        if (
+            self.state in (SYNCING, LIVE, LAGGING)
+            and self._outbox
+            and self._tick >= self._busy_until
+        ):
             expired = []
             for e in self._outbox:
                 if e["next_retry"] > self._tick:
@@ -916,6 +1018,21 @@ class SyncSession:
             self.metrics.liveness_timeouts.inc()
             self._transport_lost()
             return
+        # busy/forced coalescing has no ack to trigger the delta flush:
+        # drive it from the clock once the advertised window passes
+        # (guarded to the ISSUE 10 paths so classic lagging recovery
+        # stays ack-driven, byte-for-byte)
+        pol = self.policy
+        if (
+            self._pending_delta
+            and self.state in (LIVE, LAGGING)
+            and self._tick >= self._busy_until
+            and (
+                self._busy_until
+                or (pol is not None and getattr(pol, "force_coalesce", False))
+            )
+        ):
+            self._maybe_flush_delta()
         # heartbeat: keep an idle link observably alive
         if (
             cfg.heartbeat
@@ -925,10 +1042,16 @@ class SyncSession:
             self.metrics.heartbeats.labels(dir="send").inc()
             self._send_frame(self._envelope(K_PING).to_bytes(), "ping")
         # anti-entropy: periodic digest exchange heals silent divergence
+        # (paused under brownout — digest repair is background work the
+        # shed-background level exists to shed)
         if (
             cfg.antientropy
             and self.state == LIVE
             and self._tick - self._last_digest >= cfg.antientropy
+            and not (
+                pol is not None
+                and getattr(pol, "antientropy_paused", False)
+            )
         ):
             self._send_digest()
 
@@ -998,6 +1121,8 @@ class SyncSession:
             "shed": self.n_shed,
             "dead_lettered": self.n_dead_lettered,
             "liveness_timeouts": self.n_liveness_timeouts,
+            "busy_backoffs": self.n_busy_backoffs,
+            "busy_until": self._busy_until,
             "routing_epoch": self.routing_epoch,
             "tick": self._tick,
         }
