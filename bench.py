@@ -868,6 +868,63 @@ def bench_obs_prof(n_ops: int = 200) -> dict:
     }
 
 
+def bench_obs_dist(n_ops: int = 200) -> dict:
+    """Distributed-tracing overhead (ISSUE 11): the same per-doc
+    ingest+flush with the causal-tracing stack live at the default
+    head-sample rate (trace minting at ingress, contextvar propagation,
+    SLO flow stamping, flight recorder) vs the obs stack fully disabled
+    (``YTPU_OBS_DISABLED=1``).  The budget is <=3% end-to-end at the
+    default ``YTPU_TRACE_SAMPLE`` — tracing identity is one keyed
+    blake2b per update, everything else rides seams that already
+    existed."""
+    import gc
+
+    from yjs_tpu.obs.blackbox import flight_recorder
+    from yjs_tpu.obs.dist import sample_rate
+    from yjs_tpu.provider import TpuProvider
+
+    n_docs = int(os.environ.get("YTPU_BENCH_PROF_DOCS", "64"))
+    updates = load_distinct_traces(n_docs, n_ops)
+
+    def run(disabled: bool, runs: int = 3) -> float:
+        times = []
+        prior = os.environ.pop("YTPU_OBS_DISABLED", None)
+        if disabled:
+            os.environ["YTPU_OBS_DISABLED"] = "1"
+        try:
+            for _ in range(runs):
+                gc.collect()
+                prov = TpuProvider(n_docs)
+                t0 = time.perf_counter()
+                for i, u in enumerate(updates):
+                    prov.receive_update(f"room-{i}", u)
+                prov.flush()
+                np.asarray(prov.engine._right[:, 0])
+                times.append(time.perf_counter() - t0)
+                prov = None
+        finally:
+            if prior is None:
+                os.environ.pop("YTPU_OBS_DISABLED", None)
+            else:
+                os.environ["YTPU_OBS_DISABLED"] = prior
+        times.sort()
+        return times[len(times) // 2]
+
+    t_off = run(True)  # also warms the compile cache
+    t_on = run(False)
+    return {
+        "n_docs": n_docs,
+        "trace_ops": n_ops,
+        "sample_rate": sample_rate(),
+        "tracing_on_s": round(t_on, 4),
+        "obs_off_s": round(t_off, 4),
+        "overhead_pct": (
+            round(100 * (t_on - t_off) / t_off, 1) if t_off else 0
+        ),
+        "blackbox": flight_recorder().stats(),
+    }
+
+
 def bench_network(n_ops: int = 200) -> dict:
     """Session-layer cost (ISSUE 5): the same cross-provider fan-out
     through per-room :class:`SyncSession` pairs over an in-memory pipe,
@@ -1581,6 +1638,14 @@ def main():
             json.dump(obs_prof, f, indent=2)
     except OSError:
         pass  # artifact only; the inline detail block is authoritative
+    time.sleep(3)
+    obs_dist = bench_obs_dist()
+    try:
+        prefix = os.environ.get("YTPU_BENCH_OBS_PREFIX", "BENCH_obs")
+        with open(f"{prefix}_dist.json", "w") as f:
+            json.dump(obs_dist, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
     sweep = (
         sweep_distinct(n_ops)
         if os.environ.get("YTPU_BENCH_SWEEP")
@@ -1633,6 +1698,7 @@ def main():
             ),
             "obs": obs_summary,
             "obs_prof": obs_prof,
+            "obs_dist": obs_dist,
             "resilience": resilience,
             "durability": durability,
             "network": network,

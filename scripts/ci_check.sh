@@ -10,6 +10,18 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
+echo "== trace validity (check_trace selftest) =="
+# builds a 3-shard replicated fleet with everything sampled and
+# validates the merged Perfetto trace: all flow arrows resolve, every
+# sampled chain completes origin -> visible (ISSUE 11)
+python scripts/check_trace.py --selftest
+
+echo "== tracing smoke (marker: tracing) =="
+# the causal-tracing + flight-recorder + federation suite (ISSUE 11)
+# is the newest subsystem: context-propagation, envelope-compat, and
+# merge-semantics regressions surface fast and isolated
+python -m pytest tests/ -q -m 'tracing and not slow' -p no:cacheprovider
+
 echo "== admission smoke (marker: admission) =="
 # the rate-limit + brownout suite (ISSUE 10) is the newest subsystem:
 # bucket/fair-queue, hysteresis, and BUSY-backpressure regressions

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
 """ytpu_stats: human-readable view of yjs_tpu observability snapshots.
 
-Two modes:
+Modes:
 
     python scripts/ytpu_stats.py <snapshot.json>
         Pretty-print a metrics snapshot written by
         ``engine.metrics_snapshot()`` / ``provider.metrics_snapshot()``
         (e.g. bench.py's BENCH_obs_metrics.json artifact).
+
+    python scripts/ytpu_stats.py --merge shard0.json shard1.json ...
+    python scripts/ytpu_stats.py --merge /path/to/snapshot-dir/
+        Federate several per-shard snapshots (``yjs_tpu.obs.federate``:
+        counters sum, gauges keep per-shard series plus an aggregate,
+        histograms merge) and render the fleet view.
 
     python scripts/ytpu_stats.py --demo [--prom|--json]
         Exercise a tiny in-process provider (a few rooms, a sync
@@ -46,6 +52,9 @@ GROUPS = (
     ("tiering", ("ytpu_tier_",)),
     ("replication", ("ytpu_repl_", "ytpu_failover_")),
     ("admission", ("ytpu_adm_",)),
+    ("tracing", ("ytpu_trace_",)),
+    ("blackbox", ("ytpu_blackbox_",)),
+    ("federation", ("ytpu_fed_",)),
 )
 
 
@@ -101,6 +110,18 @@ def render_snapshot(snap: dict) -> str:
         section(title, by_group.get(title, []))
     section("other", by_group.get("other", []))
 
+    fed = snap.get("federation")
+    if fed:
+        roles = fed.get("roles") or {}
+        section(
+            "federation",
+            [
+                ("sources", _fmt(fed.get("sources", 0))),
+                ("roles",
+                 ", ".join(f"{k}={v or '-'}"
+                           for k, v in sorted(roles.items())) or "-"),
+            ],
+        )
     slo = snap.get("slo")
     if slo:
         section(
@@ -186,7 +207,13 @@ def main(argv=None) -> int:
         prog="ytpu_stats", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("snapshot", nargs="?", help="metrics snapshot JSON file")
+    ap.add_argument("snapshot", nargs="*",
+                    help="metrics snapshot JSON file(s); with --merge, "
+                         "several per-shard files or one directory")
+    ap.add_argument("--merge", action="store_true",
+                    help="federate several per-shard snapshot files (or "
+                         "a directory of them) into one labeled view "
+                         "before rendering")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny provider workload instead of reading a file")
     ap.add_argument("--prom", action="store_true",
@@ -216,9 +243,39 @@ def main(argv=None) -> int:
     if not args.snapshot:
         ap.error("either a snapshot file or --demo is required")
 
-    def render_file():
-        with open(args.snapshot) as f:
-            return render_snapshot(json.load(f))
+    if args.merge:
+        from yjs_tpu.obs.federate import (
+            federate_snapshots,
+            read_snapshot_dir,
+        )
+
+        def render_file():
+            paths = args.snapshot
+            if len(paths) == 1 and Path(paths[0]).is_dir():
+                sources = read_snapshot_dir(paths[0])
+            else:
+                sources = []
+                for p in paths:
+                    try:
+                        with open(p) as f:
+                            snap = json.load(f)
+                    except (OSError, ValueError):
+                        snap = {}
+                    if not isinstance(snap, dict):
+                        snap = {}
+                    sources.append({
+                        "label": Path(p).stem,
+                        "role": str(snap.get("role", "") or ""),
+                        "snapshot": snap,
+                    })
+            return render_snapshot(federate_snapshots(sources))
+    elif len(args.snapshot) > 1:
+        ap.error("multiple snapshot files require --merge")
+    else:
+
+        def render_file():
+            with open(args.snapshot[0]) as f:
+                return render_snapshot(json.load(f))
 
     if args.watch is not None:
         _watch(render_file, args.watch)

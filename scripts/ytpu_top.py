@@ -15,6 +15,13 @@ Sources (one row per provider):
         every ``--interval`` seconds; rates are derived from consecutive
         reads.
 
+    python scripts/ytpu_top.py /path/to/snapshot-dir/
+        Federated mode: the directory's ``*.json`` files are treated as
+        per-shard snapshots (the file-based scrape mode a multi-process
+        fleet writes) and merged via ``yjs_tpu.obs.federate`` — one
+        leading ``FLEET`` aggregate row (counters summed, histograms
+        merged) above the per-shard rows.
+
     python scripts/ytpu_top.py --demo
         Run two in-process providers exchanging sync traffic, one frame
         of fresh edits per poll — the zero-to-dashboard smoke test.
@@ -291,6 +298,29 @@ class FileSource:
         return out
 
 
+class DirSource:
+    """Federated file mode: every poll re-reads each ``*.json`` in the
+    directory as one shard's snapshot and prepends a ``FLEET`` row
+    merged across them (``ytpu_top <dir>``)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def poll(self) -> list[tuple[str, dict]]:
+        from yjs_tpu.obs.federate import (
+            federate_snapshots,
+            read_snapshot_dir,
+        )
+
+        sources = read_snapshot_dir(self.path)
+        out = [("FLEET", federate_snapshots(sources))]
+        for src in sources:
+            out.append(
+                (str(src.get("label", "?")), src.get("snapshot") or {})
+            )
+        return out
+
+
 class DemoSource:
     """Two in-process providers joined by per-room peer sessions over
     an in-memory pipe; every poll applies one fresh edit and pumps the
@@ -387,7 +417,9 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     ap.add_argument("snapshots", nargs="*",
-                    help="provider metrics-snapshot JSON files to poll")
+                    help="provider metrics-snapshot JSON files to poll, "
+                         "or ONE directory of per-shard snapshots to "
+                         "federate")
     ap.add_argument("--demo", action="store_true",
                     help="dashboard over two in-process demo providers")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -400,6 +432,8 @@ def main(argv=None) -> int:
 
     if args.demo:
         source = DemoSource()
+    elif len(args.snapshots) == 1 and Path(args.snapshots[0]).is_dir():
+        source = DirSource(args.snapshots[0])
     elif args.snapshots:
         source = FileSource(args.snapshots)
     else:

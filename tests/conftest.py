@@ -114,6 +114,15 @@ def pytest_configure(config):
         "markers",
         "loadgen: seeded multi-tenant overload harness tests",
     )
+    # "tracing" tags the causal-tracing + flight-recorder + federation
+    # suite (ISSUE 11) — in tier-1 by default (deterministic hashed
+    # trace ids), deselectable with -m 'not tracing'; ci_check.sh also
+    # runs it standalone
+    config.addinivalue_line(
+        "markers",
+        "tracing: distributed trace propagation, black-box flight "
+        "recorder, and metrics-federation tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -1,0 +1,292 @@
+"""Black-box + tracing acceptance suite (ISSUE 11): a kill-primary
+chaos run must auto-emit a flight-recorder dump whose conviction,
+promotion, and session-rehome events all share ONE forced-sampled
+episode trace id; across 20 storm seeds every dead-letter / failover
+event lands in a dump with a resolvable trace id; convergence is
+byte-identical with tracing fully on vs ``YTPU_OBS_DISABLED=1``; and a
+3-shard fleet's merged Perfetto trace validates green under
+``scripts/check_trace.py``'s invariants.
+
+Deterministic end to end: seeded edits, hash-minted trace ids, a
+jitter-free detector config so conviction lands on an exact tick.
+"""
+
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.fleet import FailoverConfig, FleetRouter
+from yjs_tpu.obs.blackbox import flight_recorder, reset_flight_recorder
+from yjs_tpu.persistence import WalConfig
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.sync.session import SessionConfig
+from yjs_tpu.sync.transport import PipeNetwork
+from yjs_tpu.updates import encode_state_as_update, encode_state_vector
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+pytestmark = [
+    pytest.mark.tracing, pytest.mark.failover, pytest.mark.chaos,
+]
+
+SMALL = WalConfig(segment_bytes=256, fsync="never")
+FAST = FailoverConfig(suspect_ticks=2, confirm_ticks=1, jitter_ticks=0)
+STORM_SEEDS = tuple(range(20))
+
+
+def seeded_rooms(seed, n_rooms=4, n_ops=8):
+    out = {}
+    for j in range(n_rooms):
+        gen = random.Random(seed * 1000 + j)
+        d = Y.Doc(gc=False)
+        d.client_id = 100 + j
+        updates = []
+        d.on("update", lambda u, origin, doc: updates.append(bytes(u)))
+        t = d.get_text("text")
+        for _ in range(n_ops):
+            if len(t) and gen.random() < 0.3:
+                t.delete(gen.randrange(len(t)), 1)
+            else:
+                t.insert(gen.randrange(len(t) + 1), gen.choice("abcdef "))
+        out[f"room-{j}"] = (d, updates)
+    return out
+
+
+def edit(doc, text, pos=0):
+    sv = encode_state_vector(doc)
+    doc.get_text("text").insert(pos, text)
+    return encode_state_as_update(doc, sv)
+
+
+def canonical(fleet, guid):
+    return Y.merge_updates([fleet.encode_state_as_update(guid)])
+
+
+def canonical_doc(doc):
+    return Y.merge_updates([encode_state_as_update(doc)])
+
+
+def convict(fleet, shard, budget=16):
+    for _ in range(budget):
+        fleet.tick()
+        if shard in fleet._down:
+            return
+    raise AssertionError(f"shard {shard} never convicted")
+
+
+def _resolvable(trace):
+    return (
+        isinstance(trace, str) and len(trace) == 32
+        and int(trace, 16) >= 0
+    )
+
+
+# -- the headline acceptance criterion ---------------------------------------
+
+
+def test_kill_primary_dumps_one_traced_episode(tmp_path, monkeypatch):
+    """Kill a primary under live sessions: the failover auto-dump must
+    contain conviction + promotion + rehome + complete events all
+    stamped with the SAME forced-sampled trace id, and the dump must
+    land on disk when ``YTPU_BLACKBOX_DIR`` is set."""
+    monkeypatch.setenv("YTPU_BLACKBOX_DIR", str(tmp_path / "bb"))
+    rec = reset_flight_recorder()
+    fleet = FleetRouter(
+        3, 4, backend="cpu", wal_dir=tmp_path / "wal", wal_config=SMALL,
+        failover_config=FAST,
+    )
+    rooms = seeded_rooms(seed=21)
+    for g, (_d, ups) in rooms.items():
+        for u in ups:
+            fleet.receive_update(g, u)
+    fleet.flush()
+    fleet.tick()
+    # a live peer session on room-0 so the failover has one to rehome
+    cfg = SessionConfig(
+        heartbeat=0, liveness=0, antientropy=0, hello_timeout=0,
+        retry_base=4, retry_jitter=0.0, seed=1,
+    )
+    pa = TpuProvider(1, backend="cpu")
+    net = PipeNetwork()
+    tx, ty = net.pair("fleet", "A")
+    sx = fleet.session("room-0", "A", cfg)
+    sy = pa.session("room-0", "fleet", cfg)
+    sx.connect(tx)
+    sy.connect(ty)
+    net.settle((sx.tick, sy.tick))
+    assert sx.state == "live"
+
+    victim = fleet.owner_of("room-0")
+    fleet.kill_shard(victim)
+    convict(fleet, victim)
+
+    dump = rec.last_dump
+    assert dump is not None and dump["reason"] == "failover"
+    fo = [e for e in dump["events"] if e["subsystem"] == "failover"]
+    kinds = {e["event"] for e in fo}
+    assert {"conviction", "promotion", "rehome", "complete"} <= kinds
+    # ONE episode trace ties the whole story together
+    traces = {e["trace"] for e in fo}
+    assert len(traces) == 1
+    (episode,) = traces
+    assert _resolvable(episode)
+    assert dump["context"]["trace"] == episode
+    assert dump["context"]["shard"] == victim
+    # the dump also shipped to disk
+    files = sorted((tmp_path / "bb").glob("blackbox-failover-*.json"))
+    assert files and files[-1].name.endswith("-0001.json")
+    # the conviction names a rehomed peer for the session we attached
+    rehomes = [e for e in fo if e["event"] == "rehome"]
+    assert any(e["guid"] == "room-0" and e["kv"]["peer"] == "A"
+               for e in rehomes)
+    # forensics never cost correctness: every doc survived promotion
+    for g, (d, _ups) in rooms.items():
+        assert canonical(fleet, g) == canonical_doc(d), g
+
+
+# -- 20-seed storm: every failure event is dumped, traced --------------------
+
+
+@pytest.mark.parametrize("seed", STORM_SEEDS)
+def test_storm_every_failure_event_dumped_with_trace(seed, tmp_path):
+    """Per seed: poison one room (dead letters + rollback), then kill
+    the primary.  Every dead-letter / failover event recorded during
+    the run must appear in an emitted dump, and every failover event
+    must carry the episode's resolvable trace id."""
+    rec = reset_flight_recorder()
+    fleet = FleetRouter(
+        3, 3, backend="cpu", wal_dir=tmp_path, wal_config=SMALL,
+        failover_config=FAST,
+    )
+    rooms = seeded_rooms(seed, n_rooms=3, n_ops=6)
+    for g, (_d, ups) in rooms.items():
+        for u in ups:
+            fleet.receive_update(g, u)
+    fleet.flush()
+    fleet.tick()
+    # dead-letter seam: a poison update rolls back and dead-letters on
+    # the owner (and on any replica that mirrors it)
+    gen = random.Random(seed)
+    poison_room = f"room-{gen.randrange(3)}"
+    fleet.receive_update(poison_room, b"\xff\xff\xff\xff\xff")
+    fleet.flush()
+    # failover seam
+    victim = fleet.owner_of("room-0")
+    fleet.kill_shard(victim)
+    convict(fleet, victim)
+
+    must_dump = [
+        e for e in rec.snapshot()
+        if (e["subsystem"], e["event"]) in (
+            ("resilience", "dead_letter"),
+            ("failover", "conviction"),
+            ("failover", "promotion"),
+            ("failover", "doc_lost"),
+            ("failover", "rehome"),
+        )
+    ]
+    assert any(e["event"] == "dead_letter" for e in must_dump), seed
+    assert any(e["event"] == "conviction" for e in must_dump), seed
+    dumped_ticks = {
+        e["tick"] for d in rec.dumps for e in d["events"]
+    }
+    for e in must_dump:
+        assert e["tick"] in dumped_ticks, (seed, e)
+        if e["subsystem"] == "failover":
+            assert _resolvable(e["trace"]), (seed, e)
+    episode = {
+        e["trace"] for e in must_dump if e["subsystem"] == "failover"
+    }
+    assert len(episode) == 1, seed
+    # and the storm never cost convergence on the healthy rooms
+    for g, (d, _ups) in rooms.items():
+        if g != poison_room:
+            assert canonical(fleet, g) == canonical_doc(d), (seed, g)
+
+
+# -- tracing must be free: byte-identical on vs off --------------------------
+
+
+def test_convergence_identical_tracing_on_vs_obs_disabled(
+    tmp_path, monkeypatch
+):
+    """The full pipeline — ingest, flush, replication, failover — must
+    produce byte-identical documents with everything sampled vs
+    ``YTPU_OBS_DISABLED=1`` (the acceptance criterion that tracing is
+    observation, never participation)."""
+
+    def run(flag_env):
+        for k, v in flag_env.items():
+            monkeypatch.setenv(k, v)
+        try:
+            reset_flight_recorder()
+            fleet = FleetRouter(
+                3, 4, backend="cpu",
+                wal_dir=tmp_path / "-".join(sorted(flag_env)),
+                wal_config=SMALL, failover_config=FAST,
+            )
+            rooms = seeded_rooms(seed=33)
+            for g, (_d, ups) in rooms.items():
+                for u in ups:
+                    fleet.receive_update(g, u)
+            fleet.flush()
+            fleet.tick()
+            victim = fleet.owner_of("room-0")
+            fleet.kill_shard(victim)
+            convict(fleet, victim)
+            for g, (d, _ups) in rooms.items():
+                fleet.receive_update(g, edit(d, "after failover "))
+            fleet.flush()
+            out = {g: canonical(fleet, g) for g in rooms}
+            refs = {g: canonical_doc(d) for g, (d, _u) in rooms.items()}
+            return out, refs
+        finally:
+            for k in flag_env:
+                monkeypatch.delenv(k)
+
+    traced, refs_a = run({"YTPU_TRACE_SAMPLE": "1"})
+    dark, refs_b = run({"YTPU_OBS_DISABLED": "1", "YTPU_BLACKBOX": "0"})
+    assert traced == dark
+    assert traced == refs_a == refs_b
+
+
+# -- the merged trace validates under check_trace's invariants ----------------
+
+
+def test_merged_fleet_trace_validates_green(monkeypatch):
+    """Everything-sampled 3-shard run, all shard tracers merged: every
+    flow arrow resolves both ways and every sampled ingress chain
+    reaches a convergence flow-finish (the same invariants CI enforces
+    via ``check_trace --selftest``)."""
+    import check_trace
+
+    monkeypatch.setenv("YTPU_TRACE_SAMPLE", "1")
+    fleet = FleetRouter(3, 4, backend="cpu")
+    rooms = seeded_rooms(seed=44)
+    for _round in range(2):
+        for g, (d, _ups) in sorted(rooms.items()):
+            fleet.receive_update(g, edit(d, f"{g} r{_round} "))
+        fleet.flush()
+        fleet.tick()
+    fleet.repl.repair_all()
+    fleet.flush()
+
+    events = []
+    for p in fleet.shards:
+        events.extend(p.engine.obs.tracer.trace_events())
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    assert check_trace.validate_events(events) == []
+    ingress = {
+        (e.get("args") or {}).get("trace")
+        for e in events
+        if str(e.get("name", "")).startswith(check_trace.INGRESS_NAMES)
+        and (e.get("args") or {}).get("trace")
+    }
+    assert ingress, "no sampled ingress spans in the merged trace"
+    assert any(
+        e.get("name") == "ytpu.repl.fanout" and e.get("ph") == "f"
+        for e in events
+    ), "no replication fan-out arrows in the merged trace"

@@ -407,16 +407,20 @@ class AdmissionController:
         v2: bool,
         undoable: bool,
         slo_key,
+        trace=None,
     ) -> None:
         """Park an already-journaled, SLO-received update for a later
-        weighted-fair drain on ``provider``'s flush."""
+        weighted-fair drain on ``provider``'s flush.  ``trace`` is the
+        ingress :class:`~yjs_tpu.obs.dist.TraceContext` (ISSUE 11): it
+        rides the queue entry with the enqueue tick so the drain can
+        attribute the queue wait to the update's trace."""
         tenant = self.tenant_of(guid)
         q = self._queues.get(id(provider))
         if q is None:
             q = self._queues[id(provider)] = WeightedFairQueue()
         q.push(
             tenant,
-            (guid, update, v2, undoable, slo_key),
+            (guid, update, v2, undoable, slo_key, trace, self._tick),
             weight=self._weights.get(tenant, 1.0),
         )
         self._queued_total += 1
@@ -432,6 +436,8 @@ class AdmissionController:
         q = self._queues.get(id(provider))
         if not q:
             return 0
+        from ..obs.dist import use_context
+
         n = 0
         self._draining = True
         try:
@@ -439,10 +445,21 @@ class AdmissionController:
                 _tenant, item = q.pop()
                 self._queued_total -= 1
                 n += 1
-                guid, update, v2, undoable, slo_key = item
-                provider._integrate_admitted(
-                    guid, update, v2, undoable, slo_key
-                )
+                guid, update, v2, undoable, slo_key, trace, enq_tick = item
+                if trace is not None and trace.sampled:
+                    # the queue-wait span of the sampled trace: ticks
+                    # parked in the weighted-fair queue before this
+                    # drain picked the update up
+                    provider.engine.obs.tracer.instant(
+                        "ytpu.adm.queue_wait",
+                        guid=guid,
+                        trace=trace.trace_hex,
+                        wait_ticks=max(0, self._tick - enq_tick),
+                    )
+                with use_context(trace):
+                    provider._integrate_admitted(
+                        guid, update, v2, undoable, slo_key
+                    )
         finally:
             self._draining = False
             if n:
@@ -586,6 +603,16 @@ class AdmissionController:
     ) -> None:
         self.metrics.transitions.labels(level=LEVEL_NAMES[new]).inc()
         self.metrics.level.set(new)
+        # brownout transitions are flight-recorder material (ISSUE 11):
+        # a post-mortem must see the degradation ladder around a failure
+        from ..obs.blackbox import flight_recorder
+
+        flight_recorder().record(
+            "admission", "brownout_transition",
+            severity="warning" if new > old else "info",
+            level=LEVEL_NAMES[new], previous=LEVEL_NAMES[old],
+            reason=reason, tick=tick,
+        )
         for p in self._providers:
             try:
                 journal = getattr(p, "journal_admission", None)

@@ -37,6 +37,8 @@ import random
 import time
 
 from ..obs import global_registry
+from ..obs import dist as obs_dist
+from ..obs.blackbox import flight_recorder
 from .hashring import _env_int
 
 __all__ = [
@@ -344,6 +346,21 @@ class FailoverCoordinator:
         det = fleet.detector
         det.force_dead(shard)
 
+        # ONE forced-sampled episode trace ties the conviction, every
+        # promotion, and every session rehome together in the black-box
+        # dump (and in any Perfetto trace a promoted shard exports).
+        # Minted from the fencing state, so a replayed chaos run with
+        # the same seed produces the same trace id.
+        ctx = obs_dist.mint_for_update(
+            f"failover:{shard}:{fleet.table.epoch}:{det.now}".encode(),
+            salt=b"failover",
+        ).force("failover")
+        bb = flight_recorder()
+        bb.record(
+            "failover", "conviction", severity="error", shard=shard,
+            trace=ctx.trace_hex, reason=reason, detector_tick=det.now,
+        )
+
         # resolve migrations the corpse was part of FIRST: the window's
         # double delivery makes the counterpart shard the freshest copy
         # by construction
@@ -375,10 +392,18 @@ class FailoverCoordinator:
                 fleet.table.unassign(guid)
                 lost.append(guid)
                 m.promotions.labels(outcome="lost").inc()
+                bb.record(
+                    "failover", "doc_lost", severity="warning",
+                    guid=guid, shard=shard, trace=ctx.trace_hex,
+                )
                 continue
             fleet.table.assign(guid, new_owner)
             promoted.append((guid, new_owner))
             m.promotions.labels(outcome="promoted").inc()
+            bb.record(
+                "failover", "promotion", guid=guid, shard=new_owner,
+                trace=ctx.trace_hex, src=shard,
+            )
 
         # fence the corpse out of placement and replication
         fleet.ring.remove(shard)
@@ -389,25 +414,50 @@ class FailoverCoordinator:
         epoch = fleet.table.bump()
         fleet.metrics.epoch.set(epoch)
         for guid in mig_promotions:
-            promoted.append((guid, fleet.table.lookup(guid)))
+            owner = fleet.table.lookup(guid)
+            promoted.append((guid, owner))
             m.promotions.labels(outcome="promoted").inc()
+            bb.record(
+                "failover", "promotion", guid=guid, shard=owner,
+                trace=ctx.trace_hex, src=shard, via="migration",
+            )
         for guid, owner in promoted:
             fleet.shards[owner].journal_repl_role(guid, "primary", epoch)
             fleet.repl.rejournal_acks(guid, owner)
+            fleet.shards[owner].engine.obs.tracer.instant(
+                "ytpu.failover.promote", guid=guid, shard=owner,
+                trace=ctx.trace_hex, epoch=epoch,
+            )
         # live sessions resume against the new primary: rehome forces
         # an immediate anti-entropy digest; seq spaces survive, so the
-        # repair is a targeted diff, never a full resync
+        # repair is a targeted diff, never a full resync.  The episode
+        # context stays installed so frames and replication records
+        # emitted by the repair carry the failover's trace id.
         affected = {g for g, _o in promoted} | set(lost)
-        for (g, _peer), sess in sorted(fleet._sessions.items()):
-            if g in affected:
-                sess.rehome(epoch)
-        fleet.repl.repair_all()
+        with obs_dist.use_context(ctx):
+            for (g, peer), sess in sorted(fleet._sessions.items()):
+                if g in affected:
+                    sess.rehome(epoch)
+                    bb.record(
+                        "failover", "rehome", guid=g, shard=shard,
+                        trace=ctx.trace_hex, peer=peer, epoch=epoch,
+                    )
+            fleet.repl.repair_all()
 
         first_miss = det.first_miss_tick(shard)
         gap = det.now - first_miss if first_miss is not None else 0
         m.unavailable_ticks.observe(gap)
         m.seconds.observe(time.perf_counter() - t0)
         fleet._refresh_gauges()
+        bb.record(
+            "failover", "complete", shard=shard, trace=ctx.trace_hex,
+            epoch=epoch, promoted=len(promoted), lost=len(lost),
+            unavailable_ticks=gap,
+        )
+        bb.dump(
+            "failover", shard=shard, cause=reason, epoch=epoch,
+            trace=ctx.trace_hex,
+        )
         return {
             "shard": shard,
             "reason": reason,

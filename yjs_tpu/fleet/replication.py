@@ -44,6 +44,8 @@ import json
 from collections import deque
 
 from ..obs import global_registry
+from ..obs.blackbox import flight_recorder
+from ..obs.dist import current_context, flow_id_for
 from ..persistence import KIND_DLQ, KIND_UPDATE
 from ..persistence.recovery import iter_file_events, scan_wal
 from ..provider import ProviderFullError
@@ -202,6 +204,10 @@ class ReplicationManager:
         self._hwm[guid] = seq
         if not targets:
             return
+        ctx = current_context()
+        trace_hex = (
+            ctx.trace_hex if ctx is not None and ctx.sampled else None
+        )
         owner = self.fleet.owner_of(guid)
         if owner is not None:
             try:
@@ -211,7 +217,23 @@ class ReplicationManager:
             except ShardDownError:
                 pass
         for dst in targets:
-            self._push(dst, ("update", guid, (seq, bytes(update), bool(v2))))
+            if trace_hex is not None and owner is not None:
+                # flow arrow: opened on the primary's tracer here, closed
+                # on the replica's tracer when the record is journaled
+                # (the id is hash-derived, so the two halves match even
+                # when the tracers export separately and merge later)
+                try:
+                    self.fleet.shards[owner].engine.obs.tracer.flow_start(
+                        "ytpu.repl.fanout",
+                        flow_id_for((trace_hex, "repl", guid, seq, dst)),
+                        guid=guid, dst=dst, trace=trace_hex,
+                    )
+                except ShardDownError:
+                    pass
+            self._push(
+                dst,
+                ("update", guid, (seq, bytes(update), bool(v2), trace_hex)),
+            )
 
     def enqueue_ack(self, guid: str, peer: str, sid: int, seq: int) -> None:
         """Fan a session receive-floor ack out to the replicas, so a
@@ -233,7 +255,14 @@ class ReplicationManager:
         kept = self._letters.setdefault(guid, [])
         kept.append(dict(letter))
         del kept[:-_LETTER_CAP]
-        for dst in self.replicas_of(guid):
+        targets = self.replicas_of(guid)
+        ctx = current_context()
+        flight_recorder().record(
+            "replication", "dlq_mirror", severity="warning", guid=guid,
+            trace=ctx.trace_hex if ctx is not None else None,
+            reason=str(reason), replicas=len(targets),
+        )
+        for dst in targets:
             self._push(dst, ("dlq", guid, (letter,)))
 
     def absorb(self, guid: str, update: bytes, v2: bool = False) -> bool:
@@ -245,15 +274,26 @@ class ReplicationManager:
         owner = self.fleet.owner_of(guid)
         exclude = {owner} if owner is not None else set()
         seq = self._hwm.get(guid, 0) + 1
+        ctx = current_context()
+        trace_hex = (
+            ctx.trace_hex if ctx is not None and ctx.sampled else None
+        )
         count = 0
         for dst in self.replicas_of(guid, exclude=exclude):
             try:
                 self._apply(dst, ("update", guid,
-                                  (seq, bytes(update), bool(v2))))
+                                  (seq, bytes(update), bool(v2),
+                                   trace_hex)))
             except ShardDownError:
                 self.fleet.detector.report_down(dst)
                 continue
             count += 1
+        flight_recorder().record(
+            "replication", "absorb",
+            severity="warning" if count else "error", guid=guid,
+            trace=ctx.trace_hex if ctx is not None else None,
+            replicas=count,
+        )
         if count == 0:
             return False
         self._hwm[guid] = seq
@@ -274,7 +314,8 @@ class ReplicationManager:
             )
             self._marked.add((guid, dst))
         if kind == "update":
-            seq, payload, v2 = data
+            seq, payload, v2 = data[:3]
+            trace_hex = data[3] if len(data) > 3 else None
             if not prov.journal_replica_record(
                 KIND_UPDATE, guid, payload, v2=v2
             ):
@@ -287,6 +328,12 @@ class ReplicationManager:
             key = (guid, dst)
             if seq > self._applied.get(key, 0):
                 self._applied[key] = seq
+            if trace_hex is not None:
+                prov.engine.obs.tracer.flow_end(
+                    "ytpu.repl.fanout",
+                    flow_id_for((trace_hex, "repl", guid, seq, dst)),
+                    guid=guid, shard=dst, trace=trace_hex,
+                )
             self.metrics.records.labels(kind="update").inc()
         elif kind == "ack":
             peer, sid, seq = data

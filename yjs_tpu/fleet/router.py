@@ -42,7 +42,11 @@ import time
 from pathlib import Path
 
 from ..admission import AdmissionController
+from ..obs import dist as obs_dist
 from ..obs import global_registry
+from ..obs.blackbox import flight_recorder
+from ..obs.expo import registry_snapshot
+from ..obs.federate import FederationMetrics, federate_snapshots
 from ..provider import ProviderFullError, TpuProvider
 from ..sync.session import (
     SessionConfig,
@@ -366,6 +370,9 @@ class FleetRouter:
                 prov.admission = self.admission
             self.admission.attach(prov)
         self.admission.claim_ticker(self)
+        # cross-shard metrics federation (ISSUE 11): ytpu_fed_* families
+        # register at construction so the schema checker sees them
+        self.fed_metrics = FederationMetrics(self.metrics.registry)
         self.failover_metrics = FailoverMetrics(self.metrics.registry)
         self.detector = FailureDetector(
             range(len(self.shards)),
@@ -526,32 +533,42 @@ class FleetRouter:
         bypass admission control — it was already admitted once."""
         mig = self._migrating.get(guid)
         k = self.shard_of(guid)
-        try:
-            accepted = self.shards[k].receive_update(
-                guid, update, v2=v2, undoable=undoable, internal=internal
-            )
-        except ShardDownError:
-            # the primary's machine is gone but the detector hasn't
-            # convicted it yet: the update is accepted ONLY if it can
-            # be journaled synchronously on a replica — an ack we hand
-            # out must never depend on the corpse alone
-            self.detector.report_down(k)
-            if not self.repl.absorb(guid, update, v2=v2):
-                raise
-            accepted = True
-        else:
-            if accepted:
-                self.repl.enqueue_update(guid, update, v2=v2)
-        if mig is not None:
+        # the fleet seam is an ingress: adopt (or mint) the update's
+        # trace context HERE so the replication fan-out and migration
+        # double-delivery below run under the same causal identity the
+        # owning shard stamps on its spans
+        ctx = obs_dist.current_context()
+        if ctx is None:
+            ctx = obs_dist.mint_for_update(bytes(update))
+        with obs_dist.use_context(ctx):
             try:
-                # the primary already admitted this update; re-gating
-                # the duplicate would double-charge the tenant's bucket
-                self.shards[mig["dst"]].receive_update(
-                    guid, update, v2=v2, internal=True
+                accepted = self.shards[k].receive_update(
+                    guid, update, v2=v2, undoable=undoable,
+                    internal=internal,
                 )
-                self.metrics.double_delivered.inc()
             except ShardDownError:
-                self.detector.report_down(mig["dst"])
+                # the primary's machine is gone but the detector hasn't
+                # convicted it yet: the update is accepted ONLY if it
+                # can be journaled synchronously on a replica — an ack
+                # we hand out must never depend on the corpse alone
+                self.detector.report_down(k)
+                if not self.repl.absorb(guid, update, v2=v2):
+                    raise
+                accepted = True
+            else:
+                if accepted:
+                    self.repl.enqueue_update(guid, update, v2=v2)
+            if mig is not None:
+                try:
+                    # the primary already admitted this update;
+                    # re-gating the duplicate would double-charge the
+                    # tenant's bucket
+                    self.shards[mig["dst"]].receive_update(
+                        guid, update, v2=v2, internal=True
+                    )
+                    self.metrics.double_delivered.inc()
+                except ShardDownError:
+                    self.detector.report_down(mig["dst"])
         return accepted
 
     def _handle_frame_routed(self, guid: str, frame: bytes):
@@ -747,6 +764,10 @@ class FleetRouter:
         self._migrating[guid] = {
             "src": src, "dst": dst, "reason": reason, "t0": t0,
         }
+        flight_recorder().record(
+            "fleet", "migration_begin", guid=guid, shard=src,
+            dst=dst, reason=reason, epoch=self.table.epoch,
+        )
 
     def complete_migration(self, guid: str) -> None:
         """Close the window: release on the source (journals the
@@ -782,6 +803,10 @@ class FleetRouter:
             time.perf_counter() - mig["t0"]
         )
         self.metrics.epoch.set(epoch)
+        flight_recorder().record(
+            "fleet", "migration_complete", guid=guid, shard=dst,
+            src=src, reason=mig["reason"], epoch=epoch,
+        )
         for (g, _peer), sess in sorted(self._sessions.items()):
             if g == guid:
                 sess.rehome(epoch)
@@ -916,6 +941,9 @@ class FleetRouter:
         self.admission.detach(prov)
         self._corpses[shard] = prov
         self.shards[shard] = DeadShard(shard)
+        flight_recorder().record(
+            "fleet", "shard_killed", severity="warning", shard=shard,
+        )
 
     def revive_shard(self, shard: int) -> dict:
         """Bring a failed-over shard back as an EMPTY primary-less
@@ -971,6 +999,10 @@ class FleetRouter:
         epoch = self.table.bump()
         self.metrics.epoch.set(epoch)
         self._refresh_gauges()
+        flight_recorder().record(
+            "fleet", "shard_revived", shard=shard, epoch=epoch,
+            fenced=len(fenced), readopted=len(readopted),
+        )
         return {
             "shard": shard,
             "epoch": epoch,
@@ -1059,17 +1091,38 @@ class FleetRouter:
         }
 
     def metrics_snapshot(self) -> dict:
-        """Merged per-shard snapshots + the fleet table (file mode for
-        ``ytpu_top``: any shard snapshot already carries the global
-        ``ytpu_fleet_*`` families; this adds the structured rows)."""
-        snap = {}
-        for k in range(len(self.shards)):
-            if not self._is_stub(k):
-                snap = self.shards[k].metrics_snapshot()
-                break
-        snap = dict(snap)
+        """FEDERATED fleet snapshot (ISSUE 11): every live shard's
+        engine-local registry is merged — counters sum across shards,
+        gauges keep per-shard ``shard=<k>,role=<role>`` series plus the
+        summed unlabeled aggregate, histograms merge count-weighted —
+        and the process-global registry (fleet/replication/failover/
+        admission families every shard shares) is layered in ONCE,
+        un-summed.  The first live shard still contributes the
+        non-registry keys (``slo``, ``tiers``, ``flush`` history), and
+        the structured ``fleet`` / ``sessions`` / ``admission`` feeds
+        ride along as before."""
+        base: dict = {}
+        sources = []
+        for k, p in enumerate(self.shards):
+            if self._is_stub(k):
+                continue
+            if not base:
+                base = p.metrics_snapshot()
+            sources.append({
+                "label": str(k),
+                "role": self._shard_role(k),
+                "snapshot": registry_snapshot(p.engine.obs.registry),
+            })
+        # observe BEFORE scraping the global registry so the federation
+        # families in this very snapshot are current
+        self.fed_metrics.observe(len(sources))
+        snap = dict(base)
+        snap.update(federate_snapshots(
+            sources, global_snapshot=registry_snapshot(global_registry())
+        ))
         snap["fleet"] = self.fleet_snapshot()
         snap["sessions"] = self.sessions_snapshot()
+        snap["admission"] = self.admission.snapshot()
         return snap
 
     # -- recovery ------------------------------------------------------------

@@ -11,6 +11,18 @@ Four pieces (ISSUE 1 tentpole):
   layered on the existing ``jax.profiler.TraceAnnotation`` wrappers;
 - :mod:`.expo` — Prometheus text dump + JSON snapshot.
 
+Fleet-wide observability (ISSUE 11):
+
+- :mod:`.dist` — deterministic cross-provider trace contexts
+  (``YTPU_TRACE_SAMPLE`` head sampling, envelope carry, hash-derived
+  flow ids);
+- :mod:`.blackbox` — the always-on black-box flight recorder
+  (``YTPU_BLACKBOX{,_CAP,_DIR}``), auto-dumped on quarantine /
+  failover / ``ProviderFullError`` / flush exceptions;
+- :mod:`.federate` — N-shard metric federation (counters sum, gauges
+  keep per-shard series, histograms merge) shared by
+  ``FleetRouter.metrics_snapshot``, ``ytpu_top`` and ``ytpu_stats``.
+
 Env knobs: ``YTPU_OBS_DISABLED=1`` (no-op registry + tracer; the flush
 history stays on so ``last_flush_metrics`` keeps its contract),
 ``YTPU_OBS_HISTORY`` (ring size, default 128), ``YTPU_TRACE_PATH``
@@ -32,6 +44,25 @@ from .registry import (  # noqa: F401
     NOOP_METRIC,
 )
 from .trace import Tracer  # noqa: F401
+from .blackbox import (  # noqa: F401
+    FlightRecorder,
+    flight_recorder,
+    reset_flight_recorder,
+)
+from .dist import (  # noqa: F401
+    TraceContext,
+    current_context,
+    flow_id_for,
+    mint_for_update,
+    trace_metrics,
+    use_context,
+)
+from .federate import (  # noqa: F401
+    FederationMetrics,
+    federate_snapshots,
+    merge_summaries,
+    read_snapshot_dir,
+)
 
 SNAPSHOT_SCHEMA_VERSION = 1
 
@@ -138,6 +169,13 @@ class EngineObs:
         self.registry = MetricsRegistry(enabled=self.enabled)
         self.history = FlushHistory(maxlen=history_len)
         self.tracer = Tracer(enabled=self.enabled)
+        # the process-global black box records even with metrics
+        # disabled (it is forensics, not telemetry); trace metrics are
+        # registered here so the schema checker sees the families after
+        # one provider construction
+        self.blackbox = flight_recorder()
+        self.blackbox._obs()
+        trace_metrics()
         r = self.registry
         self._flushes = r.counter(
             "ytpu_engine_flushes_total", "Engine flushes run"
@@ -286,6 +324,11 @@ class EngineObs:
             child.observe(metrics[f"t_{ph}_s"])
 
     def demoted(self, doc: int, reason: str) -> None:
+        ctx = current_context()
+        self.blackbox.record(
+            "engine", "demote", guid=None, doc=doc, reason=reason,
+            trace=ctx.trace_hex if ctx else None,
+        )
         if not self.enabled:
             return
         self._demotions.labels(reason=reason).inc()
@@ -319,12 +362,30 @@ class EngineObs:
     # -- resilience hooks ----------------------------------------------
 
     def rollback(self, doc: int, reason: str) -> None:
+        ctx = current_context()
+        if ctx is not None:
+            ctx.force("rollback")
+        self.blackbox.record(
+            "engine", "rollback", severity="warning", doc=doc,
+            reason=reason, trace=ctx.trace_hex if ctx else None,
+        )
         if not self.enabled:
             return
         self._rollbacks.labels(reason=reason).inc()
-        self.tracer.instant("ytpu.rollback", doc=doc, reason=reason)
+        self.tracer.instant(
+            "ytpu.rollback", doc=doc, reason=reason,
+            **({"trace": ctx.trace_hex} if ctx else {}),
+        )
 
     def dead_lettered(self, reason: str, depth: int, dropped: int) -> None:
+        ctx = current_context()
+        if ctx is not None:
+            ctx.force("dlq")
+        self.blackbox.record(
+            "resilience", "dead_letter", severity="warning",
+            reason=reason, depth=depth,
+            trace=ctx.trace_hex if ctx else None,
+        )
         if not self.enabled:
             return
         # group by the reason's stable prefix so a poison storm with

@@ -1,0 +1,192 @@
+"""Cross-shard metrics federation (ISSUE 11 tentpole, part 3).
+
+Merges N shard/provider metric snapshots (the
+:func:`~yjs_tpu.obs.expo.registry_snapshot` shape) into ONE labeled
+view:
+
+- **counters sum** across sources per labels-key (events are additive
+  across shards);
+- **gauges keep per-shard series** — each source's series re-labeled
+  with ``shard=<label>,role=<role>`` — plus a summed aggregate under
+  the original labels-key so single-provider dashboards (``ytpu_top``
+  columns, ``collect_row``) keep reading the unlabeled series;
+- **histograms merge**: counts and sums add, min/max widen, and
+  quantiles are count-weighted across sources (the snapshot shape
+  carries summaries, not raw buckets — the weighted estimate is exact
+  for count/sum/min/max and a documented approximation for p50/p95/p99).
+
+Two input paths share the merge:
+
+- **in-process** (``FleetRouter.metrics_snapshot``): per-shard
+  engine-local registries, with the process-global registry layered in
+  once, un-summed — global families are shared by every shard, so
+  summing them would multiply by N;
+- **file scrape** (:func:`read_snapshot_dir`): a directory of per-shard
+  snapshot JSON files, one process each — the mode the future
+  multi-process fleet reuses verbatim, and what ``ytpu_top <dir>`` and
+  ``ytpu_stats --merge`` consume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+__all__ = [
+    "federate_snapshots",
+    "read_snapshot_dir",
+    "merge_summaries",
+    "FederationMetrics",
+]
+
+
+def _labels_join(base: str, extra: str) -> str:
+    if not base:
+        return extra
+    if not extra:
+        return base
+    return f"{base},{extra}"
+
+
+def merge_summaries(parts: Iterable[dict]) -> dict:
+    """Merge histogram summaries: exact count/sum/min/max, count-weighted
+    quantile estimates."""
+    count = 0
+    total = 0.0
+    mn = None
+    mx = None
+    q = {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    for s in parts:
+        c = int(s.get("count", 0))
+        if not c:
+            continue
+        count += c
+        total += float(s.get("sum", 0.0))
+        smn, smx = float(s.get("min", 0.0)), float(s.get("max", 0.0))
+        mn = smn if mn is None else min(mn, smn)
+        mx = smx if mx is None else max(mx, smx)
+        for k in q:
+            q[k] += c * float(s.get(k, 0.0))
+    if not count:
+        return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    out = {"count": count, "sum": total, "min": mn, "max": mx}
+    for k, v in q.items():
+        out[k] = min(max(v / count, mn), mx)
+    return out
+
+
+def federate_snapshots(sources: list[dict],
+                       global_snapshot: Optional[dict] = None) -> dict:
+    """Merge per-shard snapshots into one federated snapshot.
+
+    ``sources`` is a list of ``{"label": str, "role": str,
+    "snapshot": dict}`` entries (``role`` optional).  The result keeps
+    the ``{counters, gauges, histograms}`` snapshot shape (so every
+    existing renderer works on it) plus a ``federation`` block naming
+    the sources merged.  ``global_snapshot``, when given, is layered in
+    once without summing — for in-process fleets whose shards all share
+    the process-global registry."""
+    counters: dict = {}
+    gauges: dict = {}
+    histograms: dict = {}
+    hist_parts: dict = {}
+    roles: dict = {}
+
+    for src in sources:
+        label = str(src.get("label", "?"))
+        role = str(src.get("role", "") or "")
+        snap = src.get("snapshot") or {}
+        roles[label] = role
+        shard_labels = f"shard={label}" + (f",role={role}" if role else "")
+        for name, series in (snap.get("counters") or {}).items():
+            dst = counters.setdefault(name, {})
+            for lk, v in series.items():
+                dst[lk] = dst.get(lk, 0) + v
+        for name, series in (snap.get("gauges") or {}).items():
+            dst = gauges.setdefault(name, {})
+            for lk, v in series.items():
+                dst[_labels_join(lk, shard_labels)] = v
+                dst[lk] = dst.get(lk, 0) + v
+        for name, series in (snap.get("histograms") or {}).items():
+            dst = hist_parts.setdefault(name, {})
+            for lk, s in series.items():
+                dst.setdefault(lk, []).append(s)
+
+    for name, series in hist_parts.items():
+        histograms[name] = {
+            lk: merge_summaries(parts) for lk, parts in series.items()
+        }
+
+    if global_snapshot:
+        for section, dst in (("counters", counters), ("gauges", gauges),
+                             ("histograms", histograms)):
+            for name, series in (global_snapshot.get(section) or {}).items():
+                if name not in dst:
+                    dst[name] = dict(series)
+
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "federation": {
+            "sources": len(sources),
+            "roles": roles,
+        },
+    }
+
+
+def read_snapshot_dir(path: str) -> list[dict]:
+    """Load every ``*.json`` metrics snapshot in a directory as a
+    federation source (label = file stem, role from the snapshot's own
+    ``role`` key when present).  Unreadable files contribute an empty
+    snapshot — a mid-write scrape renders a blank row, never crashes
+    the dashboard."""
+    sources = []
+    try:
+        names = sorted(
+            n for n in os.listdir(path) if n.endswith(".json")
+        )
+    except OSError:
+        return sources
+    for n in names:
+        label = n[: -len(".json")]
+        snap: dict = {}
+        try:
+            with open(os.path.join(path, n)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            snap = {}
+        if not isinstance(snap, dict):
+            snap = {}
+        sources.append({
+            "label": label,
+            "role": str(snap.get("role", "") or ""),
+            "snapshot": snap,
+        })
+    return sources
+
+
+class FederationMetrics:
+    """``ytpu_fed_*`` families on the process-global registry."""
+
+    def __init__(self, registry=None) -> None:
+        if registry is None:
+            from . import global_registry
+
+            registry = global_registry()
+        self.sources = registry.gauge(
+            "ytpu_fed_sources",
+            "Shard/provider metric sources merged by the last "
+            "federation pass",
+        )
+        self.merges = registry.counter(
+            "ytpu_fed_merges_total",
+            "Federated metric merges performed (fleet snapshots + file "
+            "scrapes)",
+        )
+
+    def observe(self, n_sources: int) -> None:
+        self.sources.set(int(n_sources))
+        self.merges.inc()

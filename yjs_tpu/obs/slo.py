@@ -33,7 +33,6 @@ and a short window (long/12), each divided by the error budget
 
 from __future__ import annotations
 
-import itertools
 import os
 import threading
 import time
@@ -42,6 +41,7 @@ from collections import OrderedDict, deque
 
 from ..lib0 import decoding
 from ..lib0.decoding import Decoder
+from .dist import flow_id_for
 
 # classic multiwindow burn thresholds: 14.4x burns a 30-day budget in
 # ~2 days (page); 6x in ~5 days (ticket/warning)
@@ -55,9 +55,13 @@ DEFAULT_OBJECTIVE = 0.99
 STAGES = ("receive", "integrate", "visible")
 _STATE_CODES = {"ok": 0, "warning": 1, "page": 2}
 
-# flow ids are shared across every tracker in the process so Perfetto
-# never sees two convergence flows with one id
-_FLOW_IDS = itertools.count(1)
+# flow ids are hash-derived from the update key (ISSUE 11 satellite):
+# the previous process-global counter restarted numbering relative to
+# surviving events after a YTPU_TRACE_EVENTS cap truncation, so a
+# truncated trace could pair a new flow-start with a stale flow-end of
+# the same id.  A keyed hash is stable under truncation AND matches
+# across providers/processes, which is what lets one update's
+# convergence arrows stitch into a single cross-peer trace.
 
 
 def update_key(update: bytes, v2: bool = False) -> tuple[int, int]:
@@ -153,7 +157,7 @@ class ConvergenceTracker:
         # the burn windows from other threads while a flush completes
         # pipelines (deque/dict iteration tears under mutation)
         self._lock = threading.Lock()
-        # key -> [t_origin, t_receive, t_integrate, flow_id]
+        # key -> [t_origin, t_receive, t_integrate, flow_id, trace_hex]
         self._pending: OrderedDict = OrderedDict()
         # (t_visible, breached) completions feeding the burn windows
         self._events: deque = deque(maxlen=max_events)
@@ -210,8 +214,13 @@ class ConvergenceTracker:
         self._origins.record_once(key, self._now())
         return key
 
-    def receive(self, update: bytes, v2: bool = False, guid=None):
-        """An update entered this provider; returns its tracking key."""
+    def receive(self, update: bytes, v2: bool = False, guid=None,
+                trace=None):
+        """An update entered this provider; returns its tracking key.
+        ``trace`` is the ingress :class:`~yjs_tpu.obs.dist.TraceContext`
+        when one is in flight — sampled contexts stamp their trace id
+        onto the convergence flow arrows so the per-update flow joins
+        the cross-provider trace."""
         if not self.enabled:
             return None
         key = update_key(update, v2)
@@ -221,17 +230,19 @@ class ConvergenceTracker:
         with self._lock:
             if key in self._pending:  # duplicate delivery: first one wins
                 return key
-            flow_id = next(_FLOW_IDS)
+            flow_id = flow_id_for(key)
             self._pending[key] = [
-                self._origins.lookup(key), t, None, flow_id
+                self._origins.lookup(key), t, None, flow_id,
+                trace.trace_hex if trace is not None and trace.sampled
+                else None,
             ]
             while len(self._pending) > self.max_pending:
                 self._pending.popitem(last=False)
         if self.tracer is not None:
-            self.tracer.flow_start(
-                "ytpu.convergence", flow_id,
-                client=key[0], clock=key[1], guid=guid,
-            )
+            args = {"client": key[0], "clock": key[1], "guid": guid}
+            if trace is not None and trace.sampled:
+                args["trace"] = trace.trace_hex
+            self.tracer.flow_start("ytpu.convergence", flow_id, **args)
         return key
 
     def integrated(self, key) -> None:
@@ -265,7 +276,7 @@ class ConvergenceTracker:
                 ]
             ]
         for k, rec in done:
-            t_origin, t_recv, t_int, flow_id = rec
+            t_origin, t_recv, t_int, flow_id, trace_hex = rec
             total = max(0.0, t - t_origin)
             self._latency.observe(total)
             self._stage["receive"].observe(max(0.0, t_recv - t_origin))
@@ -279,10 +290,13 @@ class ConvergenceTracker:
                 self._events.append((t, breached))
             self._completed += 1
             if tracer is not None:
-                tracer.flow_end(
-                    "ytpu.convergence", flow_id,
-                    latency_ms=round(total * 1000.0, 3), breached=breached,
-                )
+                args = {
+                    "latency_ms": round(total * 1000.0, 3),
+                    "breached": breached,
+                }
+                if trace_hex is not None:
+                    args["trace"] = trace_hex
+                tracer.flow_end("ytpu.convergence", flow_id, **args)
         if done:
             self._update_state()
         return len(done)

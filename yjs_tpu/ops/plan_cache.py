@@ -97,6 +97,14 @@ def poison_frontier() -> bytes:
     """A frontier no other mirror can share — used after any event whose
     resulting state is not provably a deterministic function of the
     digest chain (rollback, mid-step plan errors)."""
+    from ..obs.blackbox import flight_recorder
+    from ..obs.dist import current_context
+
+    ctx = current_context()
+    flight_recorder().record(
+        "plan_cache", "frontier_poisoned", severity="warning",
+        trace=ctx.trace_hex if ctx is not None else None,
+    )
     return os.urandom(16)
 
 

@@ -21,6 +21,7 @@ from .admission import AdmissionController, AdmissionRejected
 from .lib0.decoding import Decoder
 from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
+from .obs import dist as obs_dist
 from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
@@ -292,6 +293,19 @@ class TpuProvider:
                     if self.shard_id is not None
                     else "provider"
                 )
+                # capacity exhaustion is a black-box moment (ISSUE 11):
+                # the rejected guid and the in-flight trace land in the
+                # flight recorder, and a dump ships the forensics (the
+                # recorder dedupes, so a rejection burst emits one file)
+                ctx = obs_dist.current_context()
+                bb = self.engine.obs.blackbox
+                bb.record(
+                    "provider", "full", severity="error", guid=guid,
+                    shard=self.shard_id,
+                    trace=ctx.trace_hex if ctx is not None else None,
+                    n_docs=self.engine.n_docs,
+                )
+                bb.dump("provider_full", guid=guid, shard=self.shard_id)
                 raise ProviderFullError(
                     f"{where} is full ({self.engine.n_docs} docs); "
                     "release_doc() a cold room to admit "
@@ -374,6 +388,23 @@ class TpuProvider:
 
     # -- update plumbing ----------------------------------------------------
 
+    def _trace_ingress(self, update: bytes) -> "obs_dist.TraceContext":
+        """Establish the causal trace context for one ingress update
+        (ISSUE 11): adopt the in-flight context when a session envelope
+        or fleet seam already installed one, else mint deterministically
+        from the update bytes — every provider hashing the same bytes
+        computes the same trace id and sampling verdict."""
+        ctx = obs_dist.current_context()
+        origin = "adopted"
+        if ctx is None:
+            ctx = obs_dist.mint_for_update(bytes(update))
+            origin = "minted"
+        m = obs_dist.trace_metrics()
+        m.contexts.labels(origin=origin).inc()
+        if ctx.sampled:
+            m.sampled.inc()
+        return ctx
+
     def receive_update(
         self, guid: str, update: bytes, v2: bool = False,
         undoable: bool = False, internal: bool = False,
@@ -403,6 +434,7 @@ class TpuProvider:
             # gate BEFORE doc_id: a rejected writer must not allocate a
             # slot, and a queued update takes its slot at drain time
             verdict = adm.admit_update(self, guid, len(update))
+        ctx = self._trace_ingress(update)
         if verdict == "queue":
             if self.wal is not None:
                 # journaled at ENQUEUE: the queue is host memory, and
@@ -415,13 +447,16 @@ class TpuProvider:
                 self.wal.append(KIND_UPDATE, guid, update, v2=v2)
             self._m_updates_rx.inc()
             self._m_ingress_bytes.inc(len(update))
-            adm.enqueue(self, guid, bytes(update), v2, undoable, None)
+            adm.enqueue(
+                self, guid, bytes(update), v2, undoable, None, trace=ctx
+            )
             return True
         doc = self.doc_id(guid)
-        with self.engine.obs.tracer.span(
-            "ytpu.provider.receive_update", guid=guid
+        with obs_dist.use_context(ctx), self.engine.obs.tracer.span(
+            "ytpu.provider.receive_update", guid=guid,
+            **({"trace": ctx.trace_hex} if ctx.sampled else {}),
         ):
-            key = self.slo.receive(update, v2=v2, guid=guid)
+            key = self.slo.receive(update, v2=v2, guid=guid, trace=ctx)
             if self.wal is not None:
                 # journal BEFORE integrating (write-ahead): a crash between
                 # append and flush replays the update; the reverse order
@@ -448,7 +483,10 @@ class TpuProvider:
         now (``slo_key=None``), so shed traffic's queue age is invisible
         to the interactive convergence verdict."""
         if slo_key is None:
-            slo_key = self.slo.receive(update, v2=v2, guid=guid)
+            ctx = obs_dist.current_context() or obs_dist.mint_for_update(
+                bytes(update)
+            )
+            slo_key = self.slo.receive(update, v2=v2, guid=guid, trace=ctx)
         try:
             doc = self.doc_id(guid)
         except ProviderFullError as e:
@@ -584,8 +622,18 @@ class TpuProvider:
                     # belong INSIDE the flush span: this is the moment
                     # the queued updates became readable
                     self.slo.visible(tracer=tracer)
-            except Exception:
+            except Exception as e:
                 self._dirty = True  # flush incomplete: retry next call
+                # an unhandled flush exception is exactly what the
+                # black box exists for: snapshot the ring before the
+                # error unwinds into the caller (ISSUE 11)
+                bb = self.engine.obs.blackbox
+                bb.record(
+                    "provider", "flush_exception", severity="error",
+                    shard=self.shard_id,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                bb.dump("flush_exception", shard=self.shard_id)
                 raise
         if self.backend == "device" and self.engine.fallback:
             d = self.engine.demotions[0]
@@ -667,6 +715,7 @@ class TpuProvider:
                 )
                 return None
             self._m_ingress_bytes.inc(len(u))
+            ctx = self._trace_ingress(u)
             adm = self.admission
             if adm.enabled:
                 # the admission seam for session DATA / plain update
@@ -683,18 +732,23 @@ class TpuProvider:
                     # (shed traffic must not page the interactive SLO)
                     if self.wal is not None:
                         self.wal.append(KIND_UPDATE, guid, u)
-                    adm.enqueue(self, guid, bytes(u), False, False, None)
+                    adm.enqueue(
+                        self, guid, bytes(u), False, False, None,
+                        trace=ctx,
+                    )
                     return None
-            key = self.slo.receive(u, guid=guid)
-            if self.wal is not None:
-                # journal the PAYLOAD, post-validation: transport damage
-                # (dead-lettered above) never enters the durable log
-                self.wal.append(KIND_UPDATE, guid, u)
-            if self.engine.queue_update(doc, u):
-                self._dirty = True
-                self.slo.integrated(key)
-            else:
-                self.slo.rejected(key)
+            with obs_dist.use_context(ctx):
+                key = self.slo.receive(u, guid=guid, trace=ctx)
+                if self.wal is not None:
+                    # journal the PAYLOAD, post-validation: transport
+                    # damage (dead-lettered above) never enters the
+                    # durable log
+                    self.wal.append(KIND_UPDATE, guid, u)
+                if self.engine.queue_update(doc, u):
+                    self._dirty = True
+                    self.slo.integrated(key)
+                else:
+                    self.slo.rejected(key)
             return None
         # unknown frame type (newer protocol revision, or a corrupted
         # type varint): count and skip — a hostile peer must not be able

@@ -204,6 +204,20 @@ class HealthTracker:
                 self.backoff_base * (1 << (h.n_quarantines - 1)),
             )
             h.quarantined_until = self._tick + backoff
+            from ..obs.blackbox import flight_recorder
+            from ..obs.dist import current_context
+
+            ctx = current_context()
+            if ctx is not None:
+                ctx = ctx.force("quarantine")
+            bb = flight_recorder()
+            bb.record(
+                "resilience", "quarantine", severity="error",
+                trace=ctx.trace_hex if ctx is not None else None,
+                doc=doc, reason=reason, backoff_ticks=backoff,
+                n_quarantines=h.n_quarantines,
+            )
+            bb.dump("quarantine", doc=doc, cause=reason)
         else:
             h.state = DEGRADED
         self._push_gauges()

@@ -58,6 +58,13 @@ from ..lib0 import decoding, encoding
 from ..lib0.decoding import Decoder
 from ..lib0.encoding import Encoder
 from ..obs import global_registry
+from ..obs.dist import (
+    TraceContext,
+    current_context,
+    mint_for_update,
+    trace_metrics,
+    use_context,
+)
 from ..updates import (
     apply_update,
     decode_state_vector,
@@ -568,14 +575,30 @@ class SyncSession:
         self.n_busy_backoffs += 1
         self.metrics.busy_backoffs.inc()
 
-    def _data_frame(self, seq: int, inner: bytes) -> bytes:
+    def _data_frame(self, seq: int, inner: bytes,
+                    trace: TraceContext | None = None) -> bytes:
+        """``121 | K_DATA | varint seq | varint8array inner`` plus — for
+        a SAMPLED trace context (ISSUE 11) — one trailing varint8array
+        carrying the 25-byte trace blob.  Pre-PR readers decode only
+        seq + inner and never touch trailing decoder bytes; stock
+        y-protocols v13.4.9 readers skip the whole unknown type-121
+        message — zero wire change either way.  Unsampled traffic omits
+        the key entirely, so the absent path is exercised routinely."""
         enc = self._envelope(K_DATA)
         encoding.write_var_uint(enc, seq)
         encoding.write_var_uint8_array(enc, inner)
+        if trace is not None and trace.sampled:
+            encoding.write_var_uint8_array(enc, trace.to_bytes())
+            trace_metrics().carried.labels(dir="send").inc()
         return enc.to_bytes()
 
-    def _queue_data(self, inner: bytes) -> None:
-        """Seq-number one inner frame, queue for ack tracking, send."""
+    def _queue_data(self, inner: bytes,
+                    trace: TraceContext | None = None) -> None:
+        """Seq-number one inner frame, queue for ack tracking, send.
+        The trace context is stored on the outbox entry so retransmits
+        re-carry the SAME causal identity."""
+        if trace is None:
+            trace = current_context()
         self._send_seq += 1
         entry = {
             "seq": self._send_seq,
@@ -583,10 +606,11 @@ class SyncSession:
             "attempts": 0,
             "next_retry": self._tick + self._backoff(1),
             "sent": False,
+            "trace": trace,
         }
         self._outbox.append(entry)
         entry["sent"] = self._send_frame(
-            self._data_frame(entry["seq"], inner), "data"
+            self._data_frame(entry["seq"], inner, trace), "data"
         )
         self.n_sent += 1
 
@@ -638,7 +662,13 @@ class SyncSession:
             return
         inner = Encoder()
         protocol.write_update(inner, update)
-        self._queue_data(inner.to_bytes())
+        # the trace is minted from the RAW update bytes (not the framed
+        # inner), matching what a receiving provider would mint for the
+        # same payload — carried and minted identities agree (ISSUE 11)
+        self._queue_data(
+            inner.to_bytes(),
+            trace=current_context() or mint_for_update(update),
+        )
 
     def _enter_lagging(self) -> None:
         if self.state == LAGGING:
@@ -784,11 +814,26 @@ class SyncSession:
     def _on_data(self, dec: Decoder) -> None:
         seq = decoding.read_var_uint(dec)
         inner = decoding.read_var_uint8_array(dec)
+        # optional trailing trace-context key (ISSUE 11): absent on
+        # unsampled traffic and on frames from pre-PR senders; any
+        # parse trouble degrades to "no context" — never to a dead
+        # frame (the inner payload was already read intact)
+        ctx = None
+        try:
+            if dec.has_content():
+                ctx = TraceContext.from_bytes(
+                    decoding.read_var_uint8_array(dec)
+                )
+        except Exception:
+            ctx = None
+        if ctx is not None:
+            trace_metrics().carried.labels(dir="recv").inc()
         if seq <= self._recv_cum or seq in self._recv_seen:
             self._send_ack()  # duplicate: the peer missed our ack
             return
         self.n_received += 1
-        reply = self.host.handle_frame(bytes(inner))
+        with use_context(ctx):
+            reply = self.host.handle_frame(bytes(inner))
         if reply is not None and reply[0] == MESSAGE_YTPU_SESSION:
             # an envelope reply (admission BUSY) means the host REFUSED
             # this frame — it was neither applied nor journaled.  Leave
@@ -983,7 +1028,8 @@ class SyncSession:
                     continue
                 e["next_retry"] = self._tick + self._backoff(e["attempts"])
                 if self._send_frame(
-                    self._data_frame(e["seq"], e["inner"]), "data"
+                    self._data_frame(e["seq"], e["inner"], e.get("trace")),
+                    "data",
                 ):
                     e["sent"] = True
                     self.n_retransmits += 1
@@ -998,11 +1044,14 @@ class SyncSession:
                 for e in expired:
                     self.n_dead_lettered += 1
                     self.metrics.dead_lettered.inc()
-                    self.host.dead_letter(
-                        e["inner"],
-                        f"net-retry-exhausted: seq {e['seq']} after "
-                        f"{cfg.retry_max} attempts",
-                    )
+                    # dead-letter under the frame's own trace context so
+                    # the DLQ seam force-samples the right trace
+                    with use_context(e.get("trace")):
+                        self.host.dead_letter(
+                            e["inner"],
+                            f"net-retry-exhausted: seq {e['seq']} after "
+                            f"{cfg.retry_max} attempts",
+                        )
                 # the peer never confirmed those frames: let the
                 # anti-entropy loop close the gap promptly
                 self._last_digest = min(
