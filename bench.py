@@ -614,6 +614,139 @@ def bench_planner(
     return res
 
 
+def bench_flush(
+    n_docs: int = 32, warmup_ops: int = 800, ops_per_round: int = 40,
+    rounds: int = 4, chunk: int = 4,
+) -> dict:
+    """detail.flush → BENCH_flush.json: pipelined flush effectiveness
+    (ISSUE 12).  A/B on the same batched text workload — ``n_docs``
+    continuing editors, ``rounds`` incremental flush rounds each, with
+    ``YTPU_FLUSH_CHUNK`` shrunk so every flush runs n_docs/chunk staged
+    chunks and stage N+1's host pack can overlap stage N's device
+    execution.  Round 0 is the allocating warm-up; rounds 1+ are steady
+    state, where donation should eliminate reallocation entirely.
+    Reports the steady-state overlap fraction, donated-vs-realloc
+    bytes, pipelined host time (pack + honest device wait) against the
+    synchronous path's t_total, and the adaptive flush-tick p50/p99
+    batch window from a scripted busy/idle/burn drive."""
+    import gc
+
+    import yjs_tpu as Y
+    from yjs_tpu.ops import BatchEngine
+    from yjs_tpu.ops import plan_cache
+    from yjs_tpu.provider import TpuProvider
+
+    def editor_rounds(seed: int) -> list[bytes]:
+        """``rounds`` incremental update batches from one continuing
+        seeded editor.  Round 0 is a big warm-up (sizes the device
+        tables once); later rounds are small steady-state edit batches
+        that fit the warmed capacity, so they measure donation, not
+        growth."""
+        gen = random.Random(seed)
+        d = Y.Doc(gc=False)
+        d.client_id = 500 + seed
+        t = d.get_text("text")
+        out = []
+        for r in range(rounds):
+            sv = Y.encode_state_vector(d)
+            for _ in range(warmup_ops if r == 0 else ops_per_round):
+                if len(t) and gen.random() < 0.2:
+                    t.delete(gen.randrange(len(t)), 1)
+                else:
+                    t.insert(gen.randrange(len(t) + 1),
+                             gen.choice("abcdef "))
+            out.append(Y.encode_state_as_update(d, sv))
+        return out
+
+    traces = [editor_rounds(7000 + i) for i in range(n_docs)]
+
+    def drive(pipeline: bool) -> list[dict]:
+        plan_cache.reset_cache()
+        os.environ["YTPU_FLUSH_PIPELINE"] = "1" if pipeline else "0"
+        eng = BatchEngine(n_docs)
+        out = []
+        for r in range(rounds):
+            for i in range(n_docs):
+                eng.queue_update(i, traces[i][r])
+            eng.flush()
+            out.append(dict(eng.last_flush_metrics or {}))
+        del eng
+        gc.collect()
+        return out
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("YTPU_FLUSH_PIPELINE", "YTPU_FLUSH_CHUNK")
+    }
+    try:
+        os.environ["YTPU_FLUSH_CHUNK"] = str(chunk)
+        drive(pipeline=True)  # jit compile warm-up: neither mode pays it
+        sync_ms = drive(pipeline=False)
+        pipe_ms = drive(pipeline=True)
+    finally:
+        plan_cache.reset_cache()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    steady = pipe_ms[1:]
+    pack_s = sum(m["t_pack_s"] for m in steady)
+    overlap_s = sum(m["t_pack_overlap_s"] for m in steady)
+    wait_s = sum(m["t_device_wait_s"] for m in steady)
+    sync_total_s = sum(m["t_total_s"] for m in sync_ms[1:])
+    pipe_host_s = pack_s + wait_s
+
+    # adaptive flush tick: scripted busy/idle/burn drive with injected
+    # timestamps (deterministic p50/p99 of the applied batch windows)
+    prov = TpuProvider(4)
+    d = Y.Doc(gc=False)
+    gen = random.Random(99)
+    now = 0.0
+    for step in range(120):
+        now += 0.004
+        if step % 3 != 2:  # two busy ticks, then an idle one
+            sv = Y.encode_state_vector(d)
+            d.get_text("text").insert(0, gen.choice("abcdef"))
+            prov.receive_update("room", Y.encode_state_as_update(d, sv))
+        prov.flush_tick(now=now)
+    ticks = prov.flush_ticks.percentiles()
+
+    res = {
+        "n_docs": n_docs,
+        "warmup_ops": warmup_ops,
+        "ops_per_round": ops_per_round,
+        "rounds": rounds,
+        "flush_chunk": chunk,
+        "chunks_per_flush": n_docs // chunk,
+        # steady-state pipeline quality
+        "overlap_fraction": round(overlap_s / max(1e-9, pack_s), 4),
+        "donation_hit_rate": round(
+            sum(m["flush_donated"] for m in steady) / max(1, len(steady)),
+            4,
+        ),
+        "realloc_bytes_warmup": pipe_ms[0]["realloc_bytes"],
+        "realloc_bytes_steady": sum(m["realloc_bytes"] for m in steady),
+        "pipeline_depth_max": max(m["pipeline_depth"] for m in pipe_ms),
+        # A/B: pipelined host cost vs the synchronous path's wall time
+        "pipe_pack_s": round(pack_s, 6),
+        "pipe_device_wait_s": round(wait_s, 6),
+        "pipe_host_s": round(pipe_host_s, 6),
+        "sync_total_s": round(sync_total_s, 6),
+        "pipe_host_lt_sync_total": bool(pipe_host_s < sync_total_s),
+        # adaptive tick distribution under the scripted drive
+        "tick_window_p50_ms": ticks["p50_ms"],
+        "tick_window_p99_ms": ticks["p99_ms"],
+    }
+    try:
+        with open("BENCH_flush.json", "w") as f:
+            json.dump(res, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return res
+
+
 # ---------------------------------------------------------------------------
 # Variant 3: batched sync step 2 (state-vector diff) over all distinct docs
 # ---------------------------------------------------------------------------
@@ -1615,6 +1748,8 @@ def main():
     time.sleep(3)
     planner = bench_planner()
     time.sleep(3)
+    flush = bench_flush()
+    time.sleep(3)
     b4 = bench_b4_broadcast(n_docs_b4)
     time.sleep(3)
     resilience = bench_resilience()
@@ -1681,6 +1816,7 @@ def main():
             "conflict_storm_4client": storm,
             "prepend_fragmented": frag,
             "planner": planner,
+            "flush": flush,
             "sync_step2_batched": sync,
             "b4_broadcast": b4,
             "node_proxy_factor": NODE_PROXY_FACTOR,

@@ -54,7 +54,7 @@ def registered_names() -> set[str]:
 
 _KNOB_RE = re.compile(
     r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET|TIER|REPL"
-    r"|FAILOVER|PLAN|ADM|TRACE|BLACKBOX)_[A-Z0-9_]+"
+    r"|FAILOVER|PLAN|ADM|TRACE|BLACKBOX|FLUSH)_[A-Z0-9_]+"
 )
 
 

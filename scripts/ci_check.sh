@@ -16,6 +16,12 @@ echo "== trace validity (check_trace selftest) =="
 # sampled chain completes origin -> visible (ISSUE 11)
 python scripts/check_trace.py --selftest
 
+echo "== flush pipeline smoke (marker: flushpipe) =="
+# the pipelined-flush + donation + adaptive-tick suite (ISSUE 12) is
+# the newest subsystem: pipeline-on/off byte-identity, donation
+# aliasing, and tick-controller regressions surface fast and isolated
+python -m pytest tests/ -q -m 'flushpipe and not slow' -p no:cacheprovider
+
 echo "== tracing smoke (marker: tracing) =="
 # the causal-tracing + flight-recorder + federation suite (ISSUE 11)
 # is the newest subsystem: context-propagation, envelope-compat, and

@@ -38,7 +38,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 # rendered section -> metric-name prefixes it collects; names matching
 # no group land in "other" (a new family renders without a code change)
 GROUPS = (
-    ("engine", ("ytpu_engine_", "ytpu_flush")),
+    # "flush" must precede "engine": first prefix match wins and the
+    # flush pipeline families (ISSUE 12) share the ytpu_flush_ stem
+    ("flush", ("ytpu_flush_",)),
+    ("engine", ("ytpu_engine_",)),
     ("native planner", ("ytpu_native_",)),
     ("planner", ("ytpu_plan_",)),
     ("provider", ("ytpu_provider_",)),

@@ -53,6 +53,7 @@ COLUMNS = (
     ("quar", 5),
     ("wal rec", 8),
     ("occup", 6),
+    ("ovlp", 6),
     ("plnhit", 7),
     ("hot", 5),
     ("warm", 5),
@@ -149,6 +150,17 @@ def collect_row(
         "quar": int(_gauge(snap, "ytpu_resilience_docs_quarantined")),
         "wal rec": int(_counter_sum(snap, "ytpu_wal_records_appended_total")),
         "occup": f"{_gauge(snap, 'ytpu_prof_slot_occupancy'):.2f}",
+        # flush-pipeline overlap fraction (ISSUE 12): share of host pack
+        # time hidden behind an in-flight device dispatch ("-" until the
+        # pipeline has packed at least one overlapped stage)
+        "ovlp": (
+            f"{_ov['sum'] / _pk['sum']:.2f}"
+            if (_pk := _hist(snap, "ytpu_engine_phase_seconds",
+                             "phase=pack"))
+            and (_ov := _hist(snap, "ytpu_flush_pack_overlap_seconds"))
+            and _pk["sum"] > 0
+            else "-"
+        ),
         # plan-cache hit rate (process-global counters; "-" before the
         # first planned flush)
         "plnhit": (

@@ -123,6 +123,15 @@ def pytest_configure(config):
         "tracing: distributed trace propagation, black-box flight "
         "recorder, and metrics-federation tests",
     )
+    # "flushpipe" tags the pipelined-flush + donation + adaptive-tick
+    # suite (ISSUE 12) — in tier-1 by default (seeded traces, byte-
+    # identity oracles), deselectable with -m 'not flushpipe';
+    # ci_check.sh also runs it standalone first
+    config.addinivalue_line(
+        "markers",
+        "flushpipe: pipelined flush path, buffer donation, and "
+        "adaptive flush-tick tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

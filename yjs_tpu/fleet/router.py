@@ -616,6 +616,17 @@ class FleetRouter:
             if not self._is_stub(k):
                 self.shards[k].flush()
 
+    def flush_tick(self, now: float | None = None) -> bool:
+        """Adaptive flush tick fan-out (ISSUE 12): each live shard's
+        provider applies its own batch window, so a shard under brownout
+        coalesces while a burning shard flushes every tick.  Returns
+        True if any shard flushed."""
+        flushed = False
+        for k in self.live_shards:
+            if not self._is_stub(k):
+                flushed = self.shards[k].flush_tick(now) or flushed
+        return flushed
+
     def health(self) -> dict:
         return {
             "shards": [

@@ -107,6 +107,22 @@ FLUSH_METRICS_SCHEMA: dict = {
     "t_dispatch_s": 0.0,
     "t_emit_s": 0.0,
     "t_total_s": 0.0,
+    # pipelined flush (ISSUE 12): host pack time that overlapped an
+    # in-flight device dispatch, and host time spent blocked on the
+    # device (staging-buffer reuse guards + YTPU_FLUSH_PIPELINE=0's
+    # per-dispatch barrier).  Pipeline-off, overlap is 0 and the wait
+    # is the full device time; pipeline-on, pack overlap is the payoff
+    # and wait shrinks to the true dependency stalls.
+    "t_pack_overlap_s": 0.0,
+    "t_device_wait_s": 0.0,
+    # 1 when every device dispatch of this flush updated donated
+    # resident tables in place (no table growth/reallocation since the
+    # previous flush); realloc_bytes is the growth cost when it is 0
+    "flush_donated": 0,
+    "realloc_bytes": 0,
+    # max device dispatches in flight at once (0 = no dispatch or
+    # synchronous mode; the double-buffered staging pair bounds it)
+    "pipeline_depth": 0,
 }
 
 FLUSH_PHASES = ("compact", "plan", "pack", "dispatch", "emit")
@@ -303,6 +319,34 @@ class EngineObs:
             "Fraction of engine doc slots holding live rows",
             unit="ratio",
         )
+        # pipelined flush (ISSUE 12): overlap/donation accounting
+        self._flush_pipeline_depth = r.gauge(
+            "ytpu_flush_pipeline_depth",
+            "Max device dispatches in flight during the last flush "
+            "(0 = synchronous / no dispatch)",
+        )
+        self._flush_pack_overlap = r.histogram(
+            "ytpu_flush_pack_overlap_seconds",
+            "Host pack time spent while a device dispatch was "
+            "outstanding (not yet blocked on), per flush",
+            unit="s",
+        )
+        self._flush_device_wait = r.histogram(
+            "ytpu_flush_device_wait_seconds",
+            "Host time blocked waiting on device dispatches, per flush",
+            unit="s",
+        )
+        self._flush_donated = r.counter(
+            "ytpu_flush_donated_total",
+            "Flushes whose dispatches all updated donated device tables "
+            "in place (zero table reallocation)",
+        )
+        self._flush_realloc_bytes = r.counter(
+            "ytpu_flush_realloc_bytes_total",
+            "Device bytes allocated by resident-table growth (the cost "
+            "a donated steady-state flush avoids)",
+            unit="bytes",
+        )
 
     # -- hot-path recording hooks -------------------------------------
 
@@ -322,6 +366,13 @@ class EngineObs:
         self._flush_seconds.observe(metrics["t_total_s"])
         for ph, child in self._phase_children.items():
             child.observe(metrics[f"t_{ph}_s"])
+        self._flush_pipeline_depth.set(metrics["pipeline_depth"])
+        self._flush_pack_overlap.observe(metrics["t_pack_overlap_s"])
+        self._flush_device_wait.observe(metrics["t_device_wait_s"])
+        if metrics["flush_donated"]:
+            self._flush_donated.inc()
+        if metrics["realloc_bytes"]:
+            self._flush_realloc_bytes.inc(metrics["realloc_bytes"])
 
     def demoted(self, doc: int, reason: str) -> None:
         ctx = current_context()

@@ -193,6 +193,163 @@ class _PhasePair:
             self._outer.__exit__(*exc)
 
 
+def _pipeline_on() -> bool:
+    """YTPU_FLUSH_PIPELINE knob: pipelined flush is the default; ``0`` /
+    ``false`` / ``off`` restores the fully synchronous dispatch (the A/B
+    lane — byte-identical output is the pipeline's correctness bar)."""
+    return os.environ.get("YTPU_FLUSH_PIPELINE", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def _is_ready(arr) -> bool:
+    """Non-blocking device-completion probe; a backend without is_ready
+    reports ready (the blocking _wait below is still the safety fence)."""
+    try:
+        return bool(arr.is_ready())
+    except Exception:
+        return True
+
+
+class _StageSlot:
+    """One half of the double-buffered staging pair: a reusable host lanes
+    buffer plus the device dispatch output that last consumed it (the
+    reuse fence — jnp.asarray may alias host memory zero-copy, so the
+    buffer must not be rewritten while that dispatch is in flight)."""
+
+    __slots__ = ("buf", "marker")
+
+    def __init__(self):
+        self.buf = None
+        self.marker = None
+
+
+class _PackTimer:
+    """Times one host pack and books it as overlapped when a device
+    dispatch was still outstanding (dispatched this flush, not yet
+    blocked on) at pack start — the numerator of the bench overlap
+    fraction (t_pack_overlap_s / t_pack_s).  This is pack work the
+    synchronous A/B lane would have serialized behind a blocking wait;
+    it does not re-probe readiness, because an async backend that
+    happens to finish early (CPU) still proves the host never waited —
+    the honest wait time is t_device_wait_s."""
+
+    __slots__ = ("_pl", "_t0", "_overlap")
+
+    def __init__(self, pl):
+        self._pl = pl
+        self._t0 = 0.0
+        self._overlap = False
+
+    def __enter__(self):
+        self._overlap = self._pl.outstanding > 0
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._overlap:
+            self._pl.t_pack_overlap_s += time.perf_counter() - self._t0
+        return False
+
+
+class _FlushPipeline:
+    """Pipelined flush state machine (ISSUE 12): stage N+1's host-side
+    pack overlaps stage N's device execution.
+
+    JAX dispatch is asynchronous — a jitted call returns as soon as the
+    work is enqueued — so the pipeline needs no threads: it only has to
+    (a) keep packing into a DIFFERENT staging buffer than the one the
+    in-flight dispatch may still be reading (``acquire`` alternates the
+    double-buffered pair and blocks — counted as t_device_wait_s — only
+    when both halves are still feeding the device), and (b) account
+    honestly for what overlapped (``_PackTimer``).  ``sync=True`` is the
+    YTPU_FLUSH_PIPELINE=0 A/B lane: every dispatch blocks to completion
+    before the host proceeds.
+
+    One instance persists across flushes (the staging pair and in-flight
+    markers carry over, so steady state reallocates nothing);
+    ``begin_flush`` resets only the per-flush counters."""
+
+    __slots__ = (
+        "sync", "t_pack_overlap_s", "t_device_wait_s", "n_dispatches",
+        "max_depth", "outstanding", "_slots", "_turn", "_inflight",
+    )
+
+    def __init__(self):
+        self.sync = False
+        self.t_pack_overlap_s = 0.0
+        self.t_device_wait_s = 0.0
+        self.n_dispatches = 0
+        self.max_depth = 0
+        # dispatches this flush the host has not blocked on (the
+        # _PackTimer overlap predicate; reset per flush so read-backs
+        # between flushes can't inflate it)
+        self.outstanding = 0
+        self._slots = (_StageSlot(), _StageSlot())
+        self._turn = 0
+        self._inflight: list = []
+
+    def begin_flush(self, sync: bool) -> None:
+        self.sync = sync
+        self.t_pack_overlap_s = 0.0
+        self.t_device_wait_s = 0.0
+        self.n_dispatches = 0
+        self.max_depth = 0
+        self.outstanding = 0
+
+    def in_flight(self) -> bool:
+        """Prune completed dispatches; True while the device is busy."""
+        self._inflight = [a for a in self._inflight if not _is_ready(a)]
+        return bool(self._inflight)
+
+    def _wait(self, arr) -> None:
+        t0 = time.perf_counter()
+        try:
+            jax.block_until_ready(arr)
+        except Exception:
+            pass
+        self.t_device_wait_s += time.perf_counter() - t0
+        self.outstanding = 0
+
+    def acquire(self, shape, dtype) -> _StageSlot:
+        """Next staging buffer of the pair, ready for host writes.  The
+        slot's previous dispatch (two dispatches back in steady state)
+        must have consumed the buffer before it is rewritten; any block
+        here is real pipeline back-pressure, counted as device wait."""
+        self._turn ^= 1
+        slot = self._slots[self._turn]
+        if slot.marker is not None:
+            if not _is_ready(slot.marker):
+                self._wait(slot.marker)
+            slot.marker = None
+        buf = slot.buf
+        if buf is None or buf.shape != tuple(shape) or buf.dtype != dtype:
+            slot.buf = buf = np.empty(shape, dtype)
+        return slot
+
+    def pack(self) -> _PackTimer:
+        return _PackTimer(self)
+
+    def dispatched(self, marker, slot: _StageSlot | None = None) -> None:
+        """Book one device dispatch.  ``marker`` is a dispatch output
+        array — output-ready implies every input (including ``slot``'s
+        staging buffer) has been consumed."""
+        self.n_dispatches += 1
+        if slot is not None:
+            slot.marker = marker
+        if self.sync:
+            self._wait(marker)
+            self._inflight = []
+            if slot is not None:
+                slot.marker = None
+            return
+        self.outstanding += 1
+        self._inflight = [a for a in self._inflight if not _is_ready(a)]
+        self._inflight.append(marker)
+        if len(self._inflight) > self.max_depth:
+            self.max_depth = len(self._inflight)
+
+
 class BatchEngine:
     """Applies binary Yjs updates to a batch of docs on device.
 
@@ -306,6 +463,15 @@ class BatchEngine:
         self._statics: dict | None = None
         # rows per doc already uploaded and still valid on device
         self._uploaded_rows = [0] * n_docs
+        # pipelined flush state (ISSUE 12): double-buffered staging pair +
+        # in-flight dispatch markers persist ACROSS flushes so steady
+        # state neither reallocates nor stalls; per-flush counters reset
+        # in _flush.  Sync (A/B) mode is re-read from YTPU_FLUSH_PIPELINE
+        # at every flush.
+        self._pl = _FlushPipeline()
+        # device-table bytes (re)allocated during the current flush — 0 in
+        # steady state, where every dispatch donates in place
+        self._flush_realloc_bytes = 0
         # slots that ever accepted traffic (cleared by reset_doc): feeds
         # the ytpu_prof_slot_occupancy gauge in O(1) per update
         self._active_docs: set[int] = set()
@@ -672,6 +838,11 @@ class BatchEngine:
             self._starts = grow(
                 self._starts, old_seg, self._seg_cap + 1, NULL, jnp.int32
             )
+        # donation bookkeeping: a grown table is a fresh allocation, so
+        # this flush cannot have updated device state purely in place
+        self._flush_realloc_bytes += int(
+            self._right.nbytes + self._deleted.nbytes + self._starts.nbytes
+        )
         # grow the resident statics device-side (pad, no host round trip).
         # Allocation is lazy: the bulk-apply path never reads them on
         # device, so an apply-only engine spends no HBM or transfer on
@@ -686,6 +857,9 @@ class BatchEngine:
                     ((0, 0), (0, self._cap - old_cap)),
                     constant_values=fill,
                 )
+            self._flush_realloc_bytes += int(
+                sum(v.nbytes for v in self._statics.values())
+            )
 
     def _ensure_statics(self) -> None:
         if self._statics is not None:
@@ -695,6 +869,9 @@ class BatchEngine:
             key: self._put_b(np.full((b, self._cap + 1), fill, np.dtype(dtype)))
             for key, fill, dtype in self._STATIC_COLS
         }
+        self._flush_realloc_bytes += int(
+            sum(v.nbytes for v in self._statics.values())
+        )
         # everything must (re-)upload into the fresh arrays
         self._uploaded_rows = [0] * b
 
@@ -705,9 +882,7 @@ class BatchEngine:
         self._ensure_statics()
         packed = self._statics_delta(plans)
         if packed is not None:
-            self._statics = _scatter_statics(
-                self._statics, self._put_r(packed)
-            )
+            self._dispatch("statics", self._put_r(packed))
 
     def _statics_delta(self, plans):
         """This flush's NEW/changed rows as one packed [8, K] i32 block
@@ -806,9 +981,10 @@ class BatchEngine:
             stats.append(
                 {"doc": i, "rows_before": old_n, "rows_after": n_new}
             )
-        self._right = self._right.at[idx].set(self._put_r(new_right))
-        self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
-        self._starts = self._starts.at[idx].set(self._put_r(new_starts))
+        self._dispatch(
+            "rows", idx, self._put_r(new_right), self._put_r(new_deleted),
+            self._put_r(new_starts),
+        )
         return stats
 
     def compact_docs(self, docs, gc: bool = True) -> list[dict]:
@@ -900,9 +1076,14 @@ class BatchEngine:
             new_deleted[j, : len(d)] = d
             new_starts[j, : len(h)] = h
         idx = self._put_r(np.asarray(todo, np.int32))
-        self._right = self._right.at[idx].set(self._put_r(new_right))
-        self._deleted = self._deleted.at[idx].set(self._put_r(new_deleted))
-        self._starts = self._starts.at[idx].set(self._put_r(new_starts))
+        # hydrations land as stage-0 dispatches of the flush pipeline (or
+        # immediately before a device read-back): the donating row scatter
+        # sequences ahead of this flush's integrate dispatches on the
+        # device stream, so the integrate kernels always see hydrated rows
+        self._dispatch(
+            "rows", idx, self._put_r(new_right), self._put_r(new_deleted),
+            self._put_r(new_starts),
+        )
 
     def reset_doc(self, doc: int) -> None:
         """Return one slot to its just-constructed state (provider
@@ -940,7 +1121,19 @@ class BatchEngine:
 
     def _finish_flush(self, metrics: dict) -> None:
         """The single exit point of every flush path: append to the flush
-        ring (which serves last_flush_metrics) + update the registry."""
+        ring (which serves last_flush_metrics) + update the registry.
+        Pipeline bookkeeping lands here so EVERY exit — bulk, levels/seq,
+        replay, and the empty flush — emits the full shared schema."""
+        pl = self._pl
+        metrics["t_pack_overlap_s"] = pl.t_pack_overlap_s
+        metrics["t_device_wait_s"] = pl.t_device_wait_s
+        metrics["pipeline_depth"] = pl.max_depth
+        # donated: every dispatch this flush updated resident tables in
+        # place (no B*cap growth allocation anywhere in the flush)
+        metrics["flush_donated"] = int(
+            pl.n_dispatches > 0 and self._flush_realloc_bytes == 0
+        )
+        metrics["realloc_bytes"] = self._flush_realloc_bytes
         self.obs.record_flush(metrics, row_capacity=self._cap)
         if self.obs.enabled:
             self._record_device_memory()
@@ -983,8 +1176,13 @@ class BatchEngine:
 
     def _flush(self) -> None:
         t_start = time.perf_counter()
+        # per-flush pipeline counters reset; the staging pair + in-flight
+        # markers persist across flushes.  Sync (A/B) mode is re-read per
+        # flush so tests can flip YTPU_FLUSH_PIPELINE between flushes.
+        self._pl.begin_flush(sync=not _pipeline_on())
+        self._flush_realloc_bytes = 0
         # deferred warm-promotion scatters land before anything reads or
-        # integrates on top of the device link tables
+        # integrates on top of the device link tables (pipeline stage 0)
         self._apply_pending_hydrations()
         with self._phase_ctx("compact"):
             self._maybe_compact()
@@ -1102,15 +1300,18 @@ class BatchEngine:
             self._finish_flush(metrics)
             return
         if use_batch:
-            self._flush_apply_batched(
+            self._flush_bulk(
                 work, pre_svs, emitting, metrics, t_start,
-                observed=set(observing),
+                observed=set(observing), native=True,
             )
             return
         if mode == "apply":
-            self._flush_apply(plans, pre_svs, emitting, metrics, t_start, t_plan)
+            self._flush_bulk(
+                sorted(plans.items()), pre_svs, emitting, metrics, t_start,
+                native=False,
+            )
             return
-        with self._phase_ctx("pack"):
+        with self._phase_ctx("pack"), self._pl.pack():
             n_splits = _bucket(
                 max((len(p.splits) for p in plans.values()), default=0), 1
             )
@@ -1164,12 +1365,11 @@ class BatchEngine:
             statics = self._statics
         t_pack = time.perf_counter()
         with self._phase_ctx("dispatch"):
-            dyn = (self._right, self._deleted, self._starts)
             if mode == "seq":
                 self._metrics_dev = None  # no sharded counters this flush
-                dyn = kernels.batch_step(
-                    statics, dyn, self._put_b(splits), self._put_b(sched),
-                    self._put_b(dels),
+                self._dispatch(
+                    "seq", statics, self._put_b(splits),
+                    self._put_b(sched), self._put_b(dels),
                 )
             else:
                 # blockwise over the level axis (the long-context analogue,
@@ -1193,28 +1393,14 @@ class BatchEngine:
                 self._metrics_dev = None
                 for c0 in range(0, n_lv, block):
                     c1 = min(n_lv, c0 + block)
-                    args = (
+                    self._dispatch(
+                        "levels",
                         statics,
-                        dyn,
                         self._put_b(splits) if c0 == 0 else empty_splits,
                         self._put_b(lv_sched[:, c0:c1]),
                         self._put_b(dels) if c1 == n_lv else empty_dels,
                         scratch_d,
                     )
-                    if self._sharded_step is not None:
-                        # metrics stay device scalars (converting would block
-                        # the async dispatch); accumulate across blocks
-                        dyn, m = self._sharded_step(*args)
-                        self._metrics_dev = (
-                            m
-                            if self._metrics_dev is None
-                            else {
-                                k: self._metrics_dev[k] + m[k] for k in m
-                            }
-                        )
-                    else:
-                        dyn = kernels.batch_step_levels(*args)
-            self._right, self._deleted, self._starts = dyn
         t_dispatch = time.perf_counter()
 
         with self._phase_ctx("emit"):
@@ -1282,37 +1468,98 @@ class BatchEngine:
                     for cb in cbs:
                         cb(i, events)
 
-    def _dispatch_lanes(self, lanes, key):
-        """Apply one packed lanes block to the device state (meshed or
-        not) — the single dispatch point shared by both bulk paths."""
-        k_dn, k_sp, k_h, k_d = key
-        self._metrics_dev = None
+    def _dispatch(self, kind, *args, slot=None):
+        """THE one flush dispatch path (ISSUE 12): every device mutation of
+        the resident tables — bulk lanes (per-doc python plans, native
+        batched plans, and cached-plan replay alike), the levels/seq YATA
+        step, the statics delta scatter, and whole-row rebuild scatters
+        (compaction, deferred hydration) — funnels through here, so the
+        pipeline bookkeeping (in-flight markers, staging-buffer fences,
+        sync A/B mode) and any future kernel change land exactly once.
+
+        kinds:
+          "lanes"   (lanes, key)                    bulk-apply scatter
+          "seq"     (statics, splits, sched, dels)  sequential YATA step
+          "levels"  (statics, splits, lv_block, dels, scratch)  one
+                    level-axis block (sharded or not; device metrics
+                    accumulate across blocks)
+          "statics" (packed,)                       resident-column delta
+          "rows"    (idx, right, deleted, starts)   whole-row rebuild
+
+        ``slot`` ties the dispatch to the staging buffer it consumes (the
+        double-buffered pair's reuse fence).  All array args are already
+        device-placed by the caller (_put_b/_put_r)."""
         dyn = (self._right, self._deleted, self._starts)
-        if self.mesh is not None:
-            fn = self._sharded_apply.get(key)
-            if fn is None:
-                from ..parallel.mesh import sharded_apply_plan
+        if kind == "lanes":
+            lanes, key = args
+            k_dn, k_sp, k_h, k_d = key
+            self._metrics_dev = None
+            if self.mesh is not None:
+                fn = self._sharded_apply.get(key)
+                if fn is None:
+                    from ..parallel.mesh import sharded_apply_plan
 
-                fn = sharded_apply_plan(
-                    self.mesh, self.mesh.axis_names[0], *key
+                    fn = sharded_apply_plan(
+                        self.mesh, self.mesh.axis_names[0], *key
+                    )
+                    self._sharded_apply[key] = fn
+                dyn, self._metrics_dev = fn(dyn, self._put_b(lanes))
+            else:
+                dyn = kernels.apply_plan2(
+                    dyn, self._put_r(lanes[0]), k_dn, k_sp, k_h, k_d
                 )
-                self._sharded_apply[key] = fn
-            dyn, self._metrics_dev = fn(dyn, self._put_b(lanes))
-        else:
-            dyn = kernels.apply_plan2(
-                dyn, self._put_r(lanes[0]), k_dn, k_sp, k_h, k_d
+        elif kind == "seq":
+            statics, splits, sched, dels = args
+            dyn = kernels.batch_step(statics, dyn, splits, sched, dels)
+        elif kind == "levels":
+            statics, splits, lv_block, dels, scratch = args
+            largs = (statics, dyn, splits, lv_block, dels, scratch)
+            if self._sharded_step is not None:
+                # metrics stay device scalars (converting would block the
+                # async dispatch); accumulate across blocks
+                dyn, m = self._sharded_step(*largs)
+                self._metrics_dev = (
+                    m
+                    if self._metrics_dev is None
+                    else {k: self._metrics_dev[k] + m[k] for k in m}
+                )
+            else:
+                dyn = kernels.batch_step_levels(*largs)
+        elif kind == "statics":
+            (packed,) = args
+            self._statics = _scatter_statics(self._statics, packed)
+            self._pl.dispatched(next(iter(self._statics.values())), slot)
+            return
+        elif kind == "rows":
+            idx, new_right, new_deleted, new_starts = args
+            dyn = kernels.scatter_rows(
+                *dyn, idx, new_right, new_deleted, new_starts
             )
+        else:  # pragma: no cover - programming error
+            raise ValueError(f"unknown dispatch kind {kind!r}")
         self._right, self._deleted, self._starts = dyn
+        self._pl.dispatched(self._right, slot)
 
-    def _flush_apply_batched(
-        self, work, pre_svs, emitting, metrics, t_start, observed=frozenset()
+    def _flush_bulk(
+        self, items, pre_svs, emitting, metrics, t_start,
+        observed=frozenset(), native=True,
     ):
-        """Native twin of :meth:`_flush_apply` with CHUNKED OVERLAP: the
-        doc list is planned (ymx_prepare_many), packed (ymx_pack_apply),
-        and dispatched in chunks, so chunk k's lanes transfer streams to
-        the device while the host planner runs chunk k+1 — the transfer
-        no longer serializes behind the full plan pass.  Zero per-doc
-        Python anywhere in the plan/pack path."""
+        """ONE bulk flush driver (tentpole, ISSUE 12): native batched
+        plans (ymx_prepare_many / ymx_pack_apply), per-doc python plans,
+        and cached-plan replay all stream through the same chunked
+        pack -> dispatch pipeline.  Chunk k+1's host-side work (plan +
+        pack into the double-buffered staging pair) overlaps chunk k's
+        asynchronous device execution, and the donating apply kernels
+        update the resident tables in place — steady-state flushes
+        neither reallocate B*cap buffers nor block the host on the
+        device.  YTPU_FLUSH_PIPELINE=0 restores the synchronous A/B
+        lane (every dispatch blocks); output is byte-identical either
+        way.
+
+        ``items``: ``(doc, NativeMirror)`` pairs when ``native`` (planned
+        here, chunk by chunk), ``(doc, plan)`` pairs otherwise (planned
+        by _flush's plan phase, already doc-ordered)."""
+        pl = self._pl
         chunk_sz = int(os.environ.get("YTPU_FLUSH_CHUNK", "256"))
         b = self.n_docs
         n_shards = 1 if self.mesh is None else self.mesh.shape[
@@ -1322,231 +1569,113 @@ class BatchEngine:
         t_plan_acc = t_pack_acc = t_disp_acc = 0.0
         stats_tot = np.zeros(4, np.int64)
         lanes_padded_tot = 0
-        work_ok: list = []  # (doc, mirror, counts-row) across all chunks
-        demoted_now = metrics["n_demoted"]
-        rolled_back = metrics["n_rolled_back"]
+        work_ok: list = []  # native: (doc, mirror, counts); py: (doc, plan)
         max_rows_all = 0
-        cache = plan_cache.get_cache()
-        # events read plan.sched; skip building it otherwise
-        want_sched = bool(self._event_listeners)
-        cache_hits = cache_misses = 0
-        t_cached_acc = t_cold_acc = 0.0
-        cfg_threads = _native_plan_threads()
-        plan_threads_used = 1
-        for c0 in range(0, len(work), chunk_sz):
-            chunk = work[c0 : c0 + chunk_sz]
+        acc = SimpleNamespace(
+            cache=plan_cache.get_cache() if native else None,
+            # events read plan.sched; skip building it otherwise
+            want_sched=bool(self._event_listeners),
+            cfg_threads=_native_plan_threads() if native else 1,
+            plan_threads=1,
+            cache_hits=0,
+            cache_misses=0,
+            t_cached=0.0,
+            t_cold=0.0,
+            demoted=metrics["n_demoted"],
+            rolled_back=metrics["n_rolled_back"],
+        )
+        for c0 in range(0, len(items), chunk_sz):
+            chunk = items[c0 : c0 + chunk_sz]
             t0 = time.perf_counter()
-            with self._phase_ctx("plan", chunk=c0 // chunk_sz,
-                                 docs=len(chunk)):
-                chunk_ok: list = []
-                hits: list = []    # (doc, mirror, entry)
-                cold: list = []    # (doc, mirror, key) — group leaders
-                groups: dict = {}  # key -> trailing same-key members
-                if cache is not None:
-                    for i, m in chunk:
-                        key = m.plan_key(False, want_sched)
-                        g = groups.get(key)
-                        if g is not None:
-                            # intra-chunk duplicate (broadcast fan-out):
-                            # cloned from the leader after it plans
-                            g.append((i, m))
-                            continue
-                        ent = cache.lookup(key)
-                        if ent is not None:
-                            hits.append((i, m, ent))
-                        else:
-                            groups[key] = []
-                            cold.append((i, m, key))
-                else:
-                    cold = [(i, m, None) for i, m in chunk]
-                th0 = time.perf_counter()
-                for i, m, ent in hits:
-                    chunk_ok.append((i, m, m.adopt_cached(ent)))
-                cache_hits += len(hits)
-                t_cached_acc += time.perf_counter() - th0
-                retry: list = []  # members whose leader failed
-                if cold:
-                    tc0 = time.perf_counter()
-                    cache_misses += len(cold)
-                    plan_threads_used = max(
-                        plan_threads_used, min(cfg_threads, len(cold))
-                    )
-                    counts_all, rcs, staged_info = prepare_many(
-                        [(i, m) for i, m, _k in cold],
-                        want_levels=False,
-                        want_sched=want_sched,
-                        obs=self.obs,
-                    )
-                    for k, (i, m, key) in enumerate(cold):
-                        try:
-                            m._finish_prepare(
-                                int(rcs[k]), staged_info[k][0],
-                                staged_info[k][1], counts_all[k],
-                            )
-                        except UnsupportedUpdate as e:
-                            self._demote(i, pre_svs.get(i), reason=str(e))
-                            demoted_now += 1
-                            retry.extend(groups.get(key, ()))
-                        except Exception as e:
-                            if self._strict:
-                                raise
-                            self._isolate_failure(i, e, pre_svs.get(i))
-                            demoted_now += 1
-                            rolled_back += 1
-                            retry.extend(groups.get(key, ()))
-                        else:
-                            chunk_ok.append((i, m, counts_all[k]))
-                            members = groups.get(key)
-                            if members:
-                                # identical frontier + staged bytes plan
-                                # identically: clone the leader's live
-                                # post-prepare state instead of
-                                # re-walking each member
-                                th1 = time.perf_counter()
-                                src = SimpleNamespace(
-                                    h=m._h,
-                                    counts=counts_all[k],
-                                    pins=m._py_bufs,
-                                    frontier_after=m.plan_frontier,
-                                )
-                                for j, mj in members:
-                                    chunk_ok.append(
-                                        (j, mj, mj.adopt_cached(src))
-                                    )
-                                cache_hits += len(members)
-                                plan_cache.note_hits(len(members))
-                                t_cached_acc += time.perf_counter() - th1
-                            if key is not None:
-                                # post-prepare, pre-pack: the snapshot a
-                                # future hit adopts before running the
-                                # pack/dispatch phases itself
-                                cache.insert_native(key, m, counts_all[k])
-                    t_cold_acc += time.perf_counter() - tc0
-                if retry:
-                    # a leader's demote/isolate says nothing about its
-                    # members under the per-doc error policy — plan each
-                    # individually, exactly as a cache-off flush would
-                    tc0 = time.perf_counter()
-                    cache_misses += len(retry)
-                    plan_cache.note_misses(len(retry))
-                    plan_threads_used = max(
-                        plan_threads_used, min(cfg_threads, len(retry))
-                    )
-                    counts2, rcs2, staged2 = prepare_many(
-                        retry, want_levels=False, want_sched=want_sched,
-                        obs=self.obs,
-                    )
-                    for k, (i, m) in enumerate(retry):
-                        try:
-                            m._finish_prepare(
-                                int(rcs2[k]), staged2[k][0], staged2[k][1],
-                                counts2[k],
-                            )
-                        except UnsupportedUpdate as e:
-                            self._demote(i, pre_svs.get(i), reason=str(e))
-                            demoted_now += 1
-                        except Exception as e:
-                            if self._strict:
-                                raise
-                            self._isolate_failure(i, e, pre_svs.get(i))
-                            demoted_now += 1
-                            rolled_back += 1
-                        else:
-                            chunk_ok.append((i, m, counts2[k]))
-                    t_cold_acc += time.perf_counter() - tc0
-                # hit/leader/member completion order is cache-dependent;
-                # pack and emit must see the same doc order either way
-                chunk_ok.sort(key=lambda t: t[0])
+            if native:
+                with self._phase_ctx("plan", chunk=c0 // chunk_sz,
+                                     docs=len(chunk)):
+                    chunk_ok = self._plan_chunk_native(chunk, pre_svs, acc)
+            else:
+                chunk_ok = chunk
             t1 = time.perf_counter()
             t_plan_acc += t1 - t0
             if not chunk_ok:
                 continue
-            with self._phase_ctx("pack", chunk=c0 // chunk_sz):
-                counts = np.stack([c for _, _, c in chunk_ok])
-                doc_idx = np.asarray([i for i, _, _ in chunk_ok], np.int64)
-                max_rows = int(counts[:, 0].max(initial=0))
-                max_rows_all = max(max_rows_all, max_rows)
-                self._ensure_capacity(
-                    max_rows, int(counts[:, 11].max(initial=0))
-                )
-                oob_r = int(self._cap + 1)
-                oob_s = int(self._seg_cap + 1)
-                shard = doc_idx // b_loc
-                link = counts[:, 12]
-                dense = counts[:, 14].astype(bool)
-
-                def shard_max(values, mask, minimum, shard=shard):
-                    sums = np.bincount(
-                        shard[mask], weights=values[mask].astype(np.float64),
-                        minlength=n_shards,
+            with self._phase_ctx("pack", chunk=c0 // chunk_sz), pl.pack():
+                if native:
+                    slot, key, stats, max_rows = self._pack_chunk_native(
+                        chunk_ok, b_loc, n_shards
                     )
-                    return _bucket_lanes(int(sums.max(initial=0)), minimum)
-
-                all_mask = np.ones(len(chunk_ok), bool)
-                k_dn = shard_max(link, dense, 64)
-                k_sp = shard_max(link, ~dense, 64)
-                k_h = shard_max(counts[:, 13], all_mask, 8)
-                k_d = shard_max(counts[:, 6], all_mask, 64)
-                # int16 lanes when every index/count fits: half the flush
-                # bytes over the host->device link (the distinct-path
-                # bottleneck on tunneled backends)
-                lane_dtype = (
-                    np.int16
-                    if max(oob_r, oob_s, int(link.max(initial=0))) <= 32767
-                    else np.int32
-                )
-                lanes, stats = pack_apply_lanes(
-                    chunk_ok, doc_idx, b_loc, n_shards,
-                    (k_dn, k_sp, k_h, k_d),
-                    oob_r, oob_s, int(NULL), lane_dtype,
-                )
+                else:
+                    slot, key, stats, max_rows = self._pack_chunk_py(
+                        chunk_ok, b_loc, n_shards
+                    )
                 stats_tot += stats
+                max_rows_all = max(max_rows_all, max_rows)
                 # capacity is per shard; real lane counts (stats) sum across
                 # shards, so the denominator must too or meshed runs report
                 # occupancy inflated by n_shards (ADVICE r4)
-                lanes_padded_tot += n_shards * (k_dn + k_sp + k_h + k_d)
+                lanes_padded_tot += n_shards * sum(key)
                 # the apply path never reads the device statics; mark touched
                 # docs for full (re-)upload if a levels/seq flush ever runs
-                for i, _, _ in chunk_ok:
-                    self._uploaded_rows[i] = 0
+                for t in chunk_ok:
+                    self._uploaded_rows[t[0]] = 0
                 work_ok.extend(chunk_ok)
             t2 = time.perf_counter()
             t_pack_acc += t2 - t1
-            # async dispatch: the device consumes this chunk's lanes while
-            # the next loop iteration plans on the host
+            # async dispatch: the device consumes this chunk's staged lanes
+            # while the next loop iteration plans and packs on the host
+            # (the staging slot fences its buffer against premature reuse)
             with self._phase_ctx("dispatch", chunk=c0 // chunk_sz):
-                self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
+                self._dispatch("lanes", slot.buf, key, slot=slot)
             t_disp_acc += time.perf_counter() - t2
-        metrics["n_demoted"] = demoted_now
-        metrics["n_rolled_back"] = rolled_back
+        metrics["n_demoted"] = acc.demoted
+        metrics["n_rolled_back"] = acc.rolled_back
         t_dispatch = time.perf_counter()
         with self._phase_ctx("emit"):
-            # real plan objects only where the emit phase will read them:
-            # every doc when update listeners exist, observed docs for
-            # events; the log-compaction walk touches keys only.  The
-            # observed set is the PREPARE-TIME snapshot: a listener
-            # registered mid-flush (e.g. from an update callback) sees
-            # events from the next flush — plan.sched for this one may
-            # not have been built (want_sched gate)
-            plans = {
-                i: (m.make_plan(c) if emitting or i in observed else None)
-                for i, m, c in work_ok
-            }
-            self._emit_phase(plans, pre_svs, emitting, observed=observed)
+            if native:
+                # real plan objects only where the emit phase will read
+                # them: every doc when update listeners exist, observed
+                # docs for events; the log-compaction walk touches keys
+                # only.  The observed set is the PREPARE-TIME snapshot: a
+                # listener registered mid-flush (e.g. from an update
+                # callback) sees events from the next flush — plan.sched
+                # for this one may not have been built (want_sched gate)
+                plans = {
+                    i: (m.make_plan(c) if emitting or i in observed else None)
+                    for i, m, c in work_ok
+                }
+                self._emit_phase(plans, pre_svs, emitting, observed=observed)
+            else:
+                self._emit_phase(dict(work_ok), pre_svs, emitting)
         t_emit = time.perf_counter()
 
-        if work_ok:
-            counts = np.stack([c for _, _, c in work_ok])
-        else:
-            counts = np.zeros((0, 16), np.int64)
-        n_dense, n_sparse, n_heads, n_dels = (int(x) for x in stats_tot)
-        lanes_real = n_dense + n_sparse + n_heads + n_dels
-        pending_mask = counts[:, 8] == 1
-        metrics.update({
-            "n_docs_flushed": int(
+        if native:
+            counts = (
+                np.stack([c for _, _, c in work_ok])
+                if work_ok
+                else np.zeros((0, 16), np.int64)
+            )
+            n_flushed = int(
                 ((counts[:, 12] > 0) | (counts[:, 13] > 0)
                  | (counts[:, 6] > 0)).sum()
-            ),
+            )
+            pending_mask = counts[:, 8] == 1
+            n_pending = int(pending_mask.sum())
+            pending_depth = int(counts[pending_mask, 9].sum())
+        else:
+            n_flushed = sum(
+                1
+                for _, p in work_ok
+                if len(p.link_rows) or len(p.head_segs) or len(p.delete_rows)
+            )
+            pending = [
+                i for i, _ in work_ok if self.mirrors[i].has_pending()
+            ]
+            n_pending = len(pending)
+            pending_depth = sum(
+                self.mirrors[i].pending_depth() for i in pending
+            )
+        n_dense, n_sparse, n_heads, n_dels = (int(x) for x in stats_tot)
+        lanes_real = n_dense + n_sparse + n_heads + n_dels
+        metrics.update({
+            "n_docs_flushed": n_flushed,
             "n_rows_max": max_rows_all,
             "n_sched_entries": n_dense + n_sparse,
             "n_levels": 1,
@@ -1555,153 +1684,295 @@ class BatchEngine:
             "schedule_occupancy": (
                 lanes_real / lanes_padded_tot if lanes_padded_tot else 0.0
             ),
-            "n_pending_docs": int(pending_mask.sum()),
-            "pending_depth": int(counts[pending_mask, 9].sum()),
-            "t_plan_s": t_plan_acc,
-            "t_plan_cached_s": t_cached_acc,
-            "t_plan_cold_s": t_cold_acc,
-            "plan_cache_hits": cache_hits,
-            "plan_cache_misses": cache_misses,
+            "n_pending_docs": n_pending,
+            "pending_depth": pending_depth,
             "t_pack_s": t_pack_acc,
             "t_dispatch_s": t_disp_acc,
             "t_emit_s": t_emit - t_dispatch,
             "t_total_s": t_emit - t_start,
-            # widest worker pool any prepare batch in this flush actually
-            # used — min(configured width, docs in the batch); 1 when
-            # every doc was served from the plan cache
-            "plan_threads": plan_threads_used,
         })
+        if native:
+            metrics.update({
+                "t_plan_s": t_plan_acc,
+                "t_plan_cached_s": acc.t_cached,
+                "t_plan_cold_s": acc.t_cold,
+                "plan_cache_hits": acc.cache_hits,
+                "plan_cache_misses": acc.cache_misses,
+                # widest worker pool any prepare batch in this flush
+                # actually used — min(configured width, docs in the
+                # batch); 1 when every doc was served from the plan cache
+                "plan_threads": acc.plan_threads,
+            })
         self._finish_flush(metrics)
 
-    def _flush_apply(self, plans, pre_svs, emitting, metrics, t_start, t_plan):
-        """Bulk-apply dispatch: ship the planner's final link/head/delete
-        values in ONE conflict-free scatter per array (host-resolved YATA;
-        see DocMirror._list_insert / plancore.cpp list_insert)."""
-        with self._phase_ctx("pack"):
-            max_rows = max((p.n_rows for p in plans.values()), default=0)
-            max_segs = max((self.mirrors[i].n_segs for i in plans), default=0)
-            self._ensure_capacity(max_rows, max_segs)
-            b = self.n_docs
-            oob_r = np.int32(self._cap + 1)
-            # one binning "shard" on a single device; the mesh path bins
-            # per device shard so each scatters its own lanes locally
-            n_shards = 1 if self.mesh is None else self.mesh.shape[
-                self.mesh.axis_names[0]
-            ]
-            b_loc = b // n_shards
-            # per-doc counts ride in the lanes header; doc ids and dense
-            # row indices are derived ON DEVICE (kernels.apply_plan2), so
-            # the transfer carries the minimum: full-table ("dense") link
-            # loads ship values only
-            counts = np.zeros((n_shards, 4, b_loc), np.int32)
-            dense = [[] for _ in range(n_shards)]
-            sp_r = [[] for _ in range(n_shards)]
-            sp_v = [[] for _ in range(n_shards)]
-            hd_s = [[] for _ in range(n_shards)]
-            hd_v = [[] for _ in range(n_shards)]
-            dl_r = [[] for _ in range(n_shards)]
-            for i, p in plans.items():
-                s, li = divmod(i, b_loc)
-                k = len(p.link_rows)
-                rows = np.asarray(p.link_rows, np.int32)
-                vals = np.asarray(p.link_vals, np.int32)
-                if k and k == p.n_rows and rows[-1] == k - 1:
-                    counts[s, 0, li] = k
-                    dense[s].append(vals)
-                elif k:
-                    counts[s, 1, li] = k
-                    sp_r[s].append(rows)
-                    sp_v[s].append(vals)
-                hn = len(p.head_segs)
-                if hn:
-                    counts[s, 2, li] = hn
-                    hd_s[s].append(np.asarray(p.head_segs, np.int32))
-                    hd_v[s].append(np.asarray(p.head_vals, np.int32))
-                dn = len(p.delete_rows)
-                if dn:
-                    counts[s, 3, li] = dn
-                    dl_r[s].append(np.asarray(p.delete_rows, np.int32))
+    def _plan_chunk_native(self, chunk, pre_svs, acc):
+        """Plan one chunk of ``(doc, NativeMirror)`` work: cache hits
+        adopt the cached post-prepare snapshot, cold group leaders plan
+        via ONE ymx_prepare_many call, trailing same-key members clone
+        their leader.  Per-doc error policy (demote / rollback) matches
+        the python plan loop exactly; ``acc`` accumulates plan-phase
+        bookkeeping across chunks.  Returns the surviving
+        ``(doc, mirror, counts)`` triples in ascending doc order."""
+        cache = acc.cache
+        want_sched = acc.want_sched
+        chunk_ok: list = []
+        hits: list = []    # (doc, mirror, entry)
+        cold: list = []    # (doc, mirror, key) — group leaders
+        groups: dict = {}  # key -> trailing same-key members
+        if cache is not None:
+            for i, m in chunk:
+                key = m.plan_key(False, want_sched)
+                g = groups.get(key)
+                if g is not None:
+                    # intra-chunk duplicate (broadcast fan-out):
+                    # cloned from the leader after it plans
+                    g.append((i, m))
+                    continue
+                ent = cache.lookup(key)
+                if ent is not None:
+                    hits.append((i, m, ent))
+                else:
+                    groups[key] = []
+                    cold.append((i, m, key))
+        else:
+            cold = [(i, m, None) for i, m in chunk]
+        th0 = time.perf_counter()
+        for i, m, ent in hits:
+            chunk_ok.append((i, m, m.adopt_cached(ent)))
+        acc.cache_hits += len(hits)
+        acc.t_cached += time.perf_counter() - th0
+        retry: list = []  # members whose leader failed
+        if cold:
+            tc0 = time.perf_counter()
+            acc.cache_misses += len(cold)
+            acc.plan_threads = max(
+                acc.plan_threads, min(acc.cfg_threads, len(cold))
+            )
+            counts_all, rcs, staged_info = prepare_many(
+                [(i, m) for i, m, _k in cold],
+                want_levels=False,
+                want_sched=want_sched,
+                obs=self.obs,
+            )
+            for k, (i, m, key) in enumerate(cold):
+                try:
+                    m._finish_prepare(
+                        int(rcs[k]), staged_info[k][0],
+                        staged_info[k][1], counts_all[k],
+                    )
+                except UnsupportedUpdate as e:
+                    self._demote(i, pre_svs.get(i), reason=str(e))
+                    acc.demoted += 1
+                    retry.extend(groups.get(key, ()))
+                except Exception as e:
+                    if self._strict:
+                        raise
+                    self._isolate_failure(i, e, pre_svs.get(i))
+                    acc.demoted += 1
+                    acc.rolled_back += 1
+                    retry.extend(groups.get(key, ()))
+                else:
+                    chunk_ok.append((i, m, counts_all[k]))
+                    members = groups.get(key)
+                    if members:
+                        # identical frontier + staged bytes plan
+                        # identically: clone the leader's live
+                        # post-prepare state instead of
+                        # re-walking each member
+                        th1 = time.perf_counter()
+                        src = SimpleNamespace(
+                            h=m._h,
+                            counts=counts_all[k],
+                            pins=m._py_bufs,
+                            frontier_after=m.plan_frontier,
+                        )
+                        for j, mj in members:
+                            chunk_ok.append(
+                                (j, mj, mj.adopt_cached(src))
+                            )
+                        acc.cache_hits += len(members)
+                        plan_cache.note_hits(len(members))
+                        acc.t_cached += time.perf_counter() - th1
+                    if key is not None:
+                        # post-prepare, pre-pack: the snapshot a
+                        # future hit adopts before running the
+                        # pack/dispatch phases itself
+                        cache.insert_native(key, m, counts_all[k])
+            acc.t_cold += time.perf_counter() - tc0
+        if retry:
+            # a leader's demote/isolate says nothing about its
+            # members under the per-doc error policy — plan each
+            # individually, exactly as a cache-off flush would
+            tc0 = time.perf_counter()
+            acc.cache_misses += len(retry)
+            plan_cache.note_misses(len(retry))
+            acc.plan_threads = max(
+                acc.plan_threads, min(acc.cfg_threads, len(retry))
+            )
+            counts2, rcs2, staged2 = prepare_many(
+                retry, want_levels=False, want_sched=want_sched,
+                obs=self.obs,
+            )
+            for k, (i, m) in enumerate(retry):
+                try:
+                    m._finish_prepare(
+                        int(rcs2[k]), staged2[k][0], staged2[k][1],
+                        counts2[k],
+                    )
+                except UnsupportedUpdate as e:
+                    self._demote(i, pre_svs.get(i), reason=str(e))
+                    acc.demoted += 1
+                except Exception as e:
+                    if self._strict:
+                        raise
+                    self._isolate_failure(i, e, pre_svs.get(i))
+                    acc.demoted += 1
+                    acc.rolled_back += 1
+                else:
+                    chunk_ok.append((i, m, counts2[k]))
+            acc.t_cold += time.perf_counter() - tc0
+        # hit/leader/member completion order is cache-dependent;
+        # pack and emit must see the same doc order either way
+        chunk_ok.sort(key=lambda t: t[0])
+        return chunk_ok
 
-            def widths(parts_by_shard, minimum):
-                return _bucket_lanes(
-                    max(
-                        (sum(len(a) for a in parts) for parts in parts_by_shard),
-                        default=0,
-                    ),
-                    minimum,
-                )
+    def _pack_chunk_native(self, chunk_ok, b_loc, n_shards):
+        """Stage one planned native chunk: grow capacity, size the
+        per-shard lane widths, pick the int16 downshift, and run the
+        native pack (ymx_pack_apply) writing straight into the acquired
+        staging buffer.  Returns ``(slot, key, stats, max_rows)``."""
+        counts = np.stack([c for _, _, c in chunk_ok])
+        doc_idx = np.asarray([i for i, _, _ in chunk_ok], np.int64)
+        max_rows = int(counts[:, 0].max(initial=0))
+        self._ensure_capacity(
+            max_rows, int(counts[:, 11].max(initial=0))
+        )
+        oob_r = int(self._cap + 1)
+        oob_s = int(self._seg_cap + 1)
+        shard = doc_idx // b_loc
+        link = counts[:, 12]
+        dense = counts[:, 14].astype(bool)
 
-            k_dn = widths(dense, 64)
-            k_sp = widths(sp_r, 64)
-            k_h = widths(hd_s, 8)
-            k_d = widths(dl_r, 64)
-            oob_s = np.int32(self._seg_cap + 1)
+        def shard_max(values, mask, minimum, shard=shard):
+            sums = np.bincount(
+                shard[mask], weights=values[mask].astype(np.float64),
+                minlength=n_shards,
+            )
+            return _bucket_lanes(int(sums.max(initial=0)), minimum)
 
-            def fill(out, parts, pad_val):
-                flat = (
-                    np.concatenate(parts) if parts else np.zeros(0, np.int32)
-                )
-                out[: len(flat)] = flat
-                out[len(flat):] = pad_val
-                return len(flat)
+        all_mask = np.ones(len(chunk_ok), bool)
+        k_dn = shard_max(link, dense, 64)
+        k_sp = shard_max(link, ~dense, 64)
+        k_h = shard_max(counts[:, 13], all_mask, 8)
+        k_d = shard_max(counts[:, 6], all_mask, 64)
+        # int16 lanes when every index/count fits: half the flush
+        # bytes over the host->device link (the distinct-path
+        # bottleneck on tunneled backends)
+        lane_dtype = (
+            np.int16
+            if max(oob_r, oob_s, int(link.max(initial=0))) <= 32767
+            else np.int32
+        )
+        key = (k_dn, k_sp, k_h, k_d)
+        lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
+        slot = self._pl.acquire((n_shards, lane_w), lane_dtype)
+        lanes, stats = pack_apply_lanes(
+            chunk_ok, doc_idx, b_loc, n_shards, key,
+            oob_r, oob_s, int(NULL), lane_dtype, out=slot.buf,
+        )
+        slot.buf = lanes
+        return slot, key, stats, max_rows
 
-            lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
-            lanes = np.empty((n_shards, lane_w), np.int32)
-            n_dense = n_sparse = n_heads = n_dels = 0
-            for s in range(n_shards):
-                o = 0
-                lanes[s, : 4 * b_loc] = counts[s].ravel()
-                o = 4 * b_loc
-                n_dense += fill(lanes[s, o : o + k_dn], dense[s], NULL)
-                o += k_dn
-                n_sparse += fill(lanes[s, o : o + k_sp], sp_r[s], oob_r)
-                fill(lanes[s, o + k_sp : o + 2 * k_sp], sp_v[s], NULL)
-                o += 2 * k_sp
-                n_heads += fill(lanes[s, o : o + k_h], hd_s[s], oob_s)
-                fill(lanes[s, o + k_h : o + 2 * k_h], hd_v[s], NULL)
-                o += 2 * k_h
-                n_dels += fill(lanes[s, o : o + k_d], dl_r[s], oob_r)
-            # the apply path never reads the device statics; mark touched
-            # docs for full (re-)upload if a levels/seq flush ever runs
-            for i in plans:
-                self._uploaded_rows[i] = 0
-        t_pack = time.perf_counter()
-        with self._phase_ctx("dispatch"):
-            self._dispatch_lanes(lanes, (k_dn, k_sp, k_h, k_d))
-        t_dispatch = time.perf_counter()
-        with self._phase_ctx("emit"):
-            self._emit_phase(plans, pre_svs, emitting)
-        t_emit = time.perf_counter()
+    def _pack_chunk_py(self, chunk_ok, b_loc, n_shards):
+        """Python-mirror twin of :meth:`_pack_chunk_native`: bin one
+        chunk of ``(doc, plan)`` pairs into the same counts-header +
+        lanes layout (host-resolved YATA; see DocMirror._list_insert /
+        plancore.cpp list_insert), packing into the acquired staging
+        buffer.  Returns ``(slot, key, stats, max_rows)``.
 
-        # real lane counts sum across shards; scale the per-shard capacity
-        # to match (ADVICE r4: meshed occupancy was inflated by n_shards)
-        lanes_padded = len(lanes) * (k_dn + k_sp + k_h + k_d)
-        lanes_real = n_dense + n_sparse + n_heads + n_dels
-        pending_docs = [i for i in plans if self.mirrors[i].has_pending()]
-        metrics.update({
-            "n_docs_flushed": sum(
-                1
-                for p in plans.values()
-                if len(p.link_rows) or len(p.head_segs) or len(p.delete_rows)
-            ),
-            "n_rows_max": max_rows,
-            "n_sched_entries": n_dense + n_sparse,
-            "n_levels": 1,
-            "level_width": n_dense + n_sparse,
-            # bulk path: fraction of dispatched scatter lanes that are real
-            "schedule_occupancy": (
-                lanes_real / lanes_padded if lanes_padded else 0.0
-            ),
-            "n_pending_docs": len(pending_docs),
-            "pending_depth": sum(
-                self.mirrors[i].pending_depth() for i in pending_docs
-            ),
-            "t_pack_s": t_pack - t_plan,
-            "t_dispatch_s": t_dispatch - t_pack,
-            "t_emit_s": t_emit - t_dispatch,
-            "t_total_s": t_emit - t_start,
-        })
-        self._finish_flush(metrics)
+        Per-doc counts ride in the lanes header; doc ids and dense row
+        indices are derived ON DEVICE (kernels.apply_plan2), so the
+        transfer carries the minimum: full-table ("dense") link loads
+        ship values only.  One binning "shard" on a single device; the
+        mesh path bins per device shard so each scatters its own lanes
+        locally."""
+        max_rows = max((p.n_rows for _, p in chunk_ok), default=0)
+        max_segs = max(
+            (self.mirrors[i].n_segs for i, _ in chunk_ok), default=0
+        )
+        self._ensure_capacity(max_rows, max_segs)
+        oob_r = np.int32(self._cap + 1)
+        counts = np.zeros((n_shards, 4, b_loc), np.int32)
+        dense = [[] for _ in range(n_shards)]
+        sp_r = [[] for _ in range(n_shards)]
+        sp_v = [[] for _ in range(n_shards)]
+        hd_s = [[] for _ in range(n_shards)]
+        hd_v = [[] for _ in range(n_shards)]
+        dl_r = [[] for _ in range(n_shards)]
+        for i, p in chunk_ok:
+            s, li = divmod(i, b_loc)
+            k = len(p.link_rows)
+            rows = np.asarray(p.link_rows, np.int32)
+            vals = np.asarray(p.link_vals, np.int32)
+            if k and k == p.n_rows and rows[-1] == k - 1:
+                counts[s, 0, li] = k
+                dense[s].append(vals)
+            elif k:
+                counts[s, 1, li] = k
+                sp_r[s].append(rows)
+                sp_v[s].append(vals)
+            hn = len(p.head_segs)
+            if hn:
+                counts[s, 2, li] = hn
+                hd_s[s].append(np.asarray(p.head_segs, np.int32))
+                hd_v[s].append(np.asarray(p.head_vals, np.int32))
+            dn = len(p.delete_rows)
+            if dn:
+                counts[s, 3, li] = dn
+                dl_r[s].append(np.asarray(p.delete_rows, np.int32))
+
+        def widths(parts_by_shard, minimum):
+            return _bucket_lanes(
+                max(
+                    (sum(len(a) for a in parts) for parts in parts_by_shard),
+                    default=0,
+                ),
+                minimum,
+            )
+
+        k_dn = widths(dense, 64)
+        k_sp = widths(sp_r, 64)
+        k_h = widths(hd_s, 8)
+        k_d = widths(dl_r, 64)
+        oob_s = np.int32(self._seg_cap + 1)
+
+        def fill(out, parts, pad_val):
+            flat = (
+                np.concatenate(parts) if parts else np.zeros(0, np.int32)
+            )
+            out[: len(flat)] = flat
+            out[len(flat):] = pad_val
+            return len(flat)
+
+        lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
+        slot = self._pl.acquire((n_shards, lane_w), np.int32)
+        lanes = slot.buf
+        n_dense = n_sparse = n_heads = n_dels = 0
+        for s in range(n_shards):
+            o = 0
+            lanes[s, : 4 * b_loc] = counts[s].ravel()
+            o = 4 * b_loc
+            n_dense += fill(lanes[s, o : o + k_dn], dense[s], NULL)
+            o += k_dn
+            n_sparse += fill(lanes[s, o : o + k_sp], sp_r[s], oob_r)
+            fill(lanes[s, o + k_sp : o + 2 * k_sp], sp_v[s], NULL)
+            o += 2 * k_sp
+            n_heads += fill(lanes[s, o : o + k_h], hd_s[s], oob_s)
+            fill(lanes[s, o + k_h : o + 2 * k_h], hd_v[s], NULL)
+            o += 2 * k_h
+            n_dels += fill(lanes[s, o : o + k_d], dl_r[s], oob_r)
+        stats = np.asarray([n_dense, n_sparse, n_heads, n_dels], np.int64)
+        return slot, (k_dn, k_sp, k_h, k_d), stats, max_rows
 
     @property
     def last_flush_metrics(self) -> dict | None:

@@ -476,6 +476,23 @@ def apply_plan_shared(dyn, lanes, k_l, k_h, k_d):
     return right_link, deleted, starts
 
 
+@profiled("scatter_rows")
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def scatter_rows(right, deleted, starts, idx, new_right, new_deleted,
+                 new_starts):
+    """Whole-row rebuild scatter: replace docs ``idx``'s link/deleted/head
+    rows with freshly packed host columns (compaction rebuilds, deferred
+    warm-promotion hydrations).  The resident tables are donated, so the
+    rebuild updates device state in place instead of materializing a
+    second B x cap copy per array — the same donation contract as the
+    flush dispatch kernels (ISSUE 12)."""
+    return (
+        right.at[idx].set(new_right),
+        deleted.at[idx].set(new_deleted),
+        starts.at[idx].set(new_starts),
+    )
+
+
 # ---------------------------------------------------------------------------
 # segment-sorted planning kernels (ISSUE 9)
 # ---------------------------------------------------------------------------

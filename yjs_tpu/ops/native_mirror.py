@@ -903,7 +903,7 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True,
 
 
 def pack_apply_lanes(work, doc_ids, b_loc, n_shards, widths, oob_r, oob_s,
-                     null_val, dtype=np.int32):
+                     null_val, dtype=np.int32, out=None):
     """Fill the bulk-apply scatter lanes for ``work`` (post-prepare
     ``(doc_idx, NativeMirror)`` entries, rc==0) natively.  Returns
     ``(lanes, stats)`` with ``lanes`` shaped ``(n_shards, lane_w)`` and
@@ -911,7 +911,9 @@ def pack_apply_lanes(work, doc_ids, b_loc, n_shards, widths, oob_r, oob_s,
     the native twin of BatchEngine._flush_apply's pack loop.
 
     ``dtype=np.int16`` halves the transfer when every row/seg index fits
-    16 bits (the caller checks capacity); the kernel widens on device."""
+    16 bits (the caller checks capacity); the kernel widens on device.
+    ``out`` reuses a caller-owned ``(n_shards, lane_w)`` staging buffer
+    (the flush pipeline's double-buffered pair) instead of allocating."""
     k_dn, k_sp, k_h, k_d = widths
     n = len(work)
     lib = work[0][1]._lib
@@ -919,7 +921,10 @@ def pack_apply_lanes(work, doc_ids, b_loc, n_shards, widths, oob_r, oob_s,
     for k, (_i, m, *_rest) in enumerate(work):
         handles[k] = m._h
     lane_w = 4 * b_loc + k_dn + 2 * k_sp + 2 * k_h + k_d
-    lanes = np.empty((n_shards, lane_w), dtype)
+    if out is not None and out.shape == (n_shards, lane_w) and out.dtype == dtype:
+        lanes = out
+    else:
+        lanes = np.empty((n_shards, lane_w), dtype)
     stats = np.zeros(4, np.int64)
     ids = np.ascontiguousarray(doc_ids, np.int64)
     fn = lib.ymx_pack_apply16 if dtype == np.int16 else lib.ymx_pack_apply

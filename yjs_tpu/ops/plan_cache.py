@@ -171,7 +171,16 @@ def enabled() -> bool:
 class _NativeEntry:
     """A cloned C++ mirror handle frozen at post-prepare state, plus the
     Python-pinned update buffers its borrowed pointers reference and the
-    counts row the engine's pack path needs."""
+    counts row the engine's pack path needs.
+
+    Donation safety (ISSUE 12): everything held here lives on the HOST
+    — the clone, the pinned bytes, and a private copy of the counts row.
+    The pipelined flush donates the leader's device column tables into
+    the integrate/scatter kernels, so by the time a follower replays
+    this entry those device buffers have been freed and re-used; a
+    cached entry must therefore never retain a reference to any
+    ``jax.Array`` the engine dispatched.  Adoption re-packs lanes from
+    this host state into the engine's own staging slot."""
 
     kind = "native"
     __slots__ = ("lib", "h", "counts", "pins", "frontier_after", "nbytes")

@@ -16,6 +16,8 @@ from __future__ import annotations
 import base64
 import json
 import os
+import time
+from collections import deque
 
 from .admission import AdmissionController, AdmissionRejected
 from .lib0.decoding import Decoder
@@ -113,6 +115,92 @@ class _ProviderSessionHost:
 
     def journal_ack(self, sid: int, seq: int) -> None:
         self.provider.journal_session_ack(self.guid, self.peer, sid, seq)
+
+
+class FlushTickController:
+    """Adaptive flush batch window (ISSUE 12): how long a provider lets
+    traffic coalesce before the next :meth:`TpuProvider.flush_tick`
+    actually flushes.
+
+    Inputs, per tick:
+
+    - the SLO burn-rate verdict (ISSUE 4, ``ConvergenceTracker.state()``):
+      any non-"ok" state snaps the window to the minimum — visibility
+      latency is the thing being violated, so stop batching;
+    - the brownout level (ISSUE 10) via
+      ``AdmissionController.flush_interval_scale`` — the window is
+      multiplied by the brownout scale so an overloaded shard coalesces
+      flushes instead of thrashing the device, and ``force_coalesce``
+      pins the window to the maximum outright;
+    - idleness: a tick that found nothing dirty widens the window
+      geometrically (x ``YTPU_FLUSH_TICK_GROW``) up to the maximum —
+      bigger batches amortize dispatch better when nobody is waiting.
+
+    Knobs: ``YTPU_FLUSH_TICK_MIN_MS`` (default 2), ``YTPU_FLUSH_TICK_MAX_MS``
+    (default 64), ``YTPU_FLUSH_TICK_GROW`` (default 2).  Explicit
+    :meth:`TpuProvider.flush` calls bypass the window entirely."""
+
+    def __init__(self, registry=None):
+        def _env(name, default):
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                return float(default)
+
+        self.min_ms = max(0.0, _env("YTPU_FLUSH_TICK_MIN_MS", 2.0))
+        self.max_ms = max(self.min_ms, _env("YTPU_FLUSH_TICK_MAX_MS", 64.0))
+        self.grow = max(1.0, _env("YTPU_FLUSH_TICK_GROW", 2.0))
+        # current base window; starts tight so a fresh provider is
+        # responsive and only widens by observing idleness
+        self.window_ms = self.min_ms
+        # applied windows (ms) — bench_flush reads p50/p99 from here
+        self.windows: deque = deque(maxlen=512)
+        self._last: float | None = None
+        self._g_window = self._h_window = None
+        if registry is not None:
+            self._g_window = registry.gauge(
+                "ytpu_flush_tick_window_ms",
+                "Current adaptive flush batch window",
+            )
+            self._h_window = registry.histogram(
+                "ytpu_flush_tick_window_seconds",
+                "Adaptive flush batch windows as applied per tick",
+                unit="s",
+            )
+
+    def window(self, slo_state: str, scale: float = 1.0,
+               coalesce: bool = False) -> float:
+        """Effective window (ms) for this tick from the SLO verdict +
+        brownout inputs; mutates the base window on a burn verdict."""
+        if slo_state != "ok":
+            self.window_ms = self.min_ms
+        w = self.max_ms if coalesce else self.window_ms
+        return w * max(1.0, scale)
+
+    def due(self, now: float, window_ms: float) -> bool:
+        return self._last is None or (now - self._last) * 1000.0 >= window_ms
+
+    def applied(self, now: float, window_ms: float, busy: bool) -> None:
+        """Book one elapsed tick; idle ticks widen the base window."""
+        self._last = now
+        self.windows.append(window_ms)
+        if self._g_window is not None:
+            self._g_window.set(window_ms)
+            self._h_window.observe(window_ms / 1000.0)
+        if not busy:
+            self.window_ms = min(
+                self.max_ms, max(self.window_ms, self.min_ms, 0.001) * self.grow
+            )
+
+    def percentiles(self) -> dict:
+        """p50/p99 of recently applied windows (ms) — the bench surface."""
+        if not self.windows:
+            return {"p50_ms": 0.0, "p99_ms": 0.0}
+        xs = sorted(self.windows)
+        return {
+            "p50_ms": xs[len(xs) // 2],
+            "p99_ms": xs[min(len(xs) - 1, int(len(xs) * 0.99))],
+        }
 
 
 class TpuProvider:
@@ -261,6 +349,9 @@ class TpuProvider:
             else AdmissionController(admission_config, registry=r)
         )
         self.admission.attach(self)
+        # adaptive flush tick (ISSUE 12): paces flush_tick() callers by
+        # SLO burn verdict + brownout level; explicit flush() ignores it
+        self.flush_ticks = FlushTickController(r)
 
     # -- doc management -----------------------------------------------------
 
@@ -643,6 +734,33 @@ class TpuProvider:
                 f"({d['reason']}); {len(self.engine.fallback)} doc(s) on "
                 f"the CPU path"
             )
+
+    def flush_tick(self, now: float | None = None) -> bool:
+        """Adaptive flush tick (ISSUE 12): flush only when the current
+        batch window has elapsed.
+
+        The window comes from :class:`FlushTickController` — tightened
+        to the minimum while the SLO burn verdict is not "ok", widened
+        geometrically while ticks find nothing dirty, and scaled (or
+        pinned to the maximum under ``force_coalesce``) by the brownout
+        level.  ``now`` is injectable for deterministic tests.  Returns
+        True when a flush actually ran."""
+        if now is None:
+            now = time.monotonic()
+        ticks = self.flush_ticks
+        adm = self.admission
+        scale = float(getattr(adm, "flush_interval_scale", 1.0))
+        coalesce = bool(getattr(adm, "force_coalesce", False))
+        w = ticks.window(self.slo.state(), scale, coalesce)
+        if not ticks.due(now, w):
+            return False
+        if adm.enabled:
+            adm.drain_for(self)
+        busy = self._dirty
+        if busy:
+            self.flush()
+        ticks.applied(now, w, busy)
+        return busy
 
     # -- y-protocols sync framing ------------------------------------------
 

@@ -350,7 +350,14 @@ class TierManager:
         decode).  Cold: the journaled state replays through the normal
         decode path.  Either way the doc's dead letters return to the
         slot, and a ``KIND_TIER`` "hot" marker is journaled so recovery
-        knows the demote marker no longer stands."""
+        knows the demote marker no longer stands.
+
+        Pipeline note (ISSUE 12): hydration only STAGES host rows; the
+        device scatter is deferred to the next flush, where it rides
+        the engine's single ``_dispatch`` seam as a donated
+        ``scatter_rows`` stage.  The staged host copy belongs to the
+        engine, so the warm mirror released here never aliases a
+        donated device buffer."""
         src = self.tier_of(guid)
         if src not in (WARM, COLD):
             raise KeyError(f"{guid!r} is not demoted (tier={src})")
