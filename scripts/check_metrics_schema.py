@@ -1,110 +1,73 @@
 #!/usr/bin/env python
 """Diff the LIVE registered metric names against README's documented list.
 
-Instantiates a provider (which registers every engine + provider metric
-family at construction) plus the process-global registry, extracts the
-``ytpu_*`` names from the README Observability table, and fails when
-either side has a name the other lacks — so the docs and the exposition
-surface cannot drift apart.  Also cross-checks the resilience/chaos/
-durability/profiling/network/fleet env knobs (``YTPU_CHAOS_*`` /
-``YTPU_RESILIENCE_*`` / ``YTPU_DLQ_*`` / ``YTPU_WAL_*`` /
-``YTPU_PROF_*`` / ``YTPU_SLO_*`` / ``YTPU_NET_*`` / ``YTPU_FLEET_*`` /
-``YTPU_TIER_*`` / ``YTPU_ADM_*``)
-read by the code against the knobs README documents.  Wired as a tier-1
-check via tests/test_obs.py-adjacent usage, scripts/ci_check.sh, and
-runnable standalone:
+Thin shim over :func:`yjs_tpu.analysis.drift.live_comparison` — the
+knob/metric drift logic moved into the ytpu-lint static-analysis suite
+(``scripts/ytpu_lint.py``, rules ``knob-drift`` / ``metric-drift``),
+which additionally checks at the AST level that every ``YTPU_*`` env
+read and literal ``ytpu_*`` registration is documented.  This script
+keeps the original live half: instantiate a provider + the smallest
+fleet, extract the registered family names, and fail when they and the
+README Observability table disagree (plus the curated-prefix env-knob
+cross-check).  Wired into scripts/ci_check.sh and runnable standalone:
 
     python scripts/check_metrics_schema.py
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
+from yjs_tpu.analysis.drift import (  # noqa: E402
+    KNOB_RE,
+    documented_metrics,
+    live_comparison,
+)
+
+
+# -- original module API, kept for the tier-1 tests that import it ------------
 
 def documented_names(readme_text: str) -> set[str]:
-    """Backticked ytpu_* names from the Observability metric table rows
-    (lines shaped ``| `ytpu_...` | kind | ...``)."""
-    names = set()
-    for line in readme_text.splitlines():
-        m = re.match(r"\|\s*`(ytpu_[a-z0-9_]+)`\s*\|", line)
-        if m:
-            names.add(m.group(1))
-    return names
+    """Backticked ytpu_* names from the Observability metric table."""
+    return documented_metrics(readme_text)
 
 
 def registered_names() -> set[str]:
+    from yjs_tpu.analysis.runner import register_lint_metric
     from yjs_tpu.fleet import FleetRouter
     from yjs_tpu.obs import global_registry
     from yjs_tpu.provider import TpuProvider
 
     prov = TpuProvider(1)
     # the smallest possible fleet registers every ytpu_fleet_* family
-    # on the global registry (ISSUE 6)
+    # on the global registry (ISSUE 6); the lint counter is part of the
+    # documented contract too
     FleetRouter(1, 1)
+    register_lint_metric()
     return set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )
 
 
-_KNOB_RE = re.compile(
-    r"YTPU_(?:CHAOS|RESILIENCE|DLQ|WAL|PROF|SLO|NET|FLEET|TIER|REPL"
-    r"|FAILOVER|PLAN|ADM|TRACE|BLACKBOX|FLUSH)_[A-Z0-9_]+"
-)
-
-
 def resilience_knobs_in_code() -> set[str]:
-    """Resilience/chaos env names the package actually reads."""
+    """Curated-prefix env names the package actually mentions."""
     knobs: set[str] = set()
     for path in (ROOT / "yjs_tpu").rglob("*.py"):
-        knobs |= set(_KNOB_RE.findall(path.read_text()))
+        knobs |= set(KNOB_RE.findall(path.read_text()))
     return knobs
 
 
-def resilience_knobs_in_readme(readme_text: str) -> set[str]:
-    return set(_KNOB_RE.findall(readme_text))
-
-
 def main() -> int:
-    readme = (ROOT / "README.md").read_text()
-    doc = documented_names(readme)
-    live = registered_names()
-    if not live:
-        print("obs disabled (YTPU_OBS_DISABLED) — nothing to check")
-        return 0
-    undocumented = sorted(live - doc)
-    stale = sorted(doc - live)
-    if undocumented:
-        print("registered but NOT in README's Observability table:")
-        for n in undocumented:
-            print(f"  {n}")
-    if stale:
-        print("documented in README but NOT registered:")
-        for n in stale:
-            print(f"  {n}")
-    code_knobs = resilience_knobs_in_code()
-    doc_knobs = resilience_knobs_in_readme(readme)
-    knob_undoc = sorted(code_knobs - doc_knobs)
-    knob_stale = sorted(doc_knobs - code_knobs)
-    if knob_undoc:
-        print("env knobs read by the code but NOT in README:")
-        for n in knob_undoc:
-            print(f"  {n}")
-    if knob_stale:
-        print("env knobs in README but NOT read by the code:")
-        for n in knob_stale:
-            print(f"  {n}")
-    if undocumented or stale or knob_undoc or knob_stale:
+    problems = live_comparison(ROOT)
+    for p in problems:
+        print(p)
+    if problems:
         return 1
-    print(
-        f"ok: {len(live)} metric families and {len(code_knobs)} "
-        "resilience env knobs, docs and code agree"
-    )
+    print("ok: live metric families and env knobs agree with README")
     return 0
 
 

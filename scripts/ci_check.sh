@@ -7,6 +7,12 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+echo "== static analysis (ytpu-lint) =="
+# the pure-ast checker suite (ISSUE 13): donation-aliasing, retrace
+# hazards, lock discipline/ordering, seam completeness, knob/metric
+# drift — exits nonzero on any unsuppressed finding or stale baseline
+python scripts/ytpu_lint.py --ci
+
 echo "== metrics schema =="
 python scripts/check_metrics_schema.py
 
@@ -15,6 +21,11 @@ echo "== trace validity (check_trace selftest) =="
 # validates the merged Perfetto trace: all flow arrows resolve, every
 # sampled chain completes origin -> visible (ISSUE 11)
 python scripts/check_trace.py --selftest
+
+echo "== analysis smoke (marker: analysis) =="
+# the ytpu-lint framework suite (ISSUE 13): fixture corpus, suppression
+# and baseline round-trips, and the whole-repo self-run
+python -m pytest tests/ -q -m 'analysis and not slow' -p no:cacheprovider
 
 echo "== flush pipeline smoke (marker: flushpipe) =="
 # the pipelined-flush + donation + adaptive-tick suite (ISSUE 12) is

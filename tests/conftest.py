@@ -132,6 +132,14 @@ def pytest_configure(config):
         "flushpipe: pipelined flush path, buffer donation, and "
         "adaptive flush-tick tests",
     )
+    # "analysis" tags the ytpu-lint static-analysis suite (ISSUE 13) —
+    # in tier-1 by default (pure-ast, fixtures are parsed not
+    # imported), deselectable with -m 'not analysis'; ci_check.sh also
+    # runs it standalone
+    config.addinivalue_line(
+        "markers",
+        "analysis: ytpu-lint checker, suppression, and baseline tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -1,0 +1,14 @@
+"""Known-clean: the canonical same-statement rebind after donation."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def advance(statics, dyn):
+    return dyn
+
+
+def clean_rebind(statics, dyn):
+    dyn = advance(statics, dyn)
+    return dyn

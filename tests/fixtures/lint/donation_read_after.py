@@ -1,0 +1,14 @@
+"""Known-bad: reads a buffer after donating it to a jitted kernel."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def step(statics, dyn):
+    return dyn
+
+
+def bad_read_after(statics, dyn):
+    out = step(statics, dyn)
+    return dyn.sum() + out  # BAD: dyn was donated at the call above

@@ -1,0 +1,16 @@
+"""Known-bad: reads the splatted tuple after a *args splat covered a
+donated position."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(2,))
+def fused(statics, idx, dyn):
+    return dyn
+
+
+def bad_splat(statics, args):
+    out = fused(statics, *args)
+    probe = args[1]  # BAD: the splat covered the donated position
+    return out, probe

@@ -1,0 +1,11 @@
+"""Known-bad drift: reads an undocumented knob and registers an
+undocumented metric family."""
+import os
+
+
+def setup(registry):
+    wal_dir = os.environ.get("YTPU_WAL_DIR", "/tmp/wal")
+    depth = int(os.environ.get("YTPU_SECRET_DEPTH", "4"))  # BAD: no README row
+    flushes = registry.counter("ytpu_flush_total", "flushes", unit="flushes")
+    hidden = registry.counter("ytpu_hidden_total", "BAD: no README row")
+    return wal_dir, depth, flushes, hidden

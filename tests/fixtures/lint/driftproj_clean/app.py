@@ -1,0 +1,9 @@
+"""Known-clean drift: every knob read and metric registered has its
+README row, and nothing documented is dead."""
+import os
+
+
+def setup(registry):
+    wal_dir = os.environ.get("YTPU_WAL_DIR", "/tmp/wal")
+    flushes = registry.counter("ytpu_flush_total", "flushes", unit="flushes")
+    return wal_dir, flushes

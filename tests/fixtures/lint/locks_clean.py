@@ -1,0 +1,16 @@
+"""Known-clean: every access to the guarded attribute holds the lock."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def put(self, v):
+        with self._lock:
+            self._value = v
+
+    def get(self):
+        with self._lock:
+            return self._value

@@ -1,0 +1,19 @@
+"""Known-bad: two methods take the same two locks in opposite order —
+the ABBA deadlock the ordering graph exists to catch."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            with self._a:  # BAD: closes the a->b->a cycle
+                return 2

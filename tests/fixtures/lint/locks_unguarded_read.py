@@ -1,0 +1,16 @@
+"""Known-bad: an attribute written under the class lock is read
+lock-free from another method — the torn-scrape race."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items[-1]  # BAD: guarded attr read without the lock

@@ -1,0 +1,13 @@
+"""Known-bad: inline array ctor sized by a per-call length fed to a
+jitted kernel — every distinct length retraces."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(xs):
+    return xs
+
+
+def bad_inline(items):
+    return kernel(jnp.zeros(len(items)))  # BAD: unbucketed dynamic shape

@@ -1,0 +1,14 @@
+"""Known-bad: a len()-derived value at a static_argnums position —
+every distinct value is a separate compile-cache entry."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(1,))
+def sized_kernel(xs, n):
+    return xs
+
+
+def bad_static(xs, items):
+    return sized_kernel(xs, len(items))  # BAD: unbucketed static value

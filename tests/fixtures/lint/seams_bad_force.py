@@ -1,0 +1,12 @@
+"""Known-bad: a failure-path record() attaches a trace at severity
+error but the function never .force()-samples the context."""
+
+
+def fail_path(recorder, ctx, err):
+    recorder.record(  # BAD: trace may have been head-sampled away
+        "replication",
+        "mirror_failed",
+        severity="error",
+        trace=ctx,
+        detail=str(err),
+    )

@@ -1,0 +1,8 @@
+"""Known-bad: an ingress seam that neither establishes a TraceContext
+nor feeds the SLO pipeline nor delegates to another seam."""
+
+
+class Shard:
+    def receive_update(self, update):  # BAD: no trace, no slo, no delegate
+        self.log.append(update)
+        return True

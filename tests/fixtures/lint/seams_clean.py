@@ -1,0 +1,24 @@
+"""Known-clean seams: the ingress adopts a context and feeds the SLO
+pipeline; the failure path force-samples before recording; a second
+ingress delegates both obligations to a routed seam."""
+
+
+class Router:
+    def receive_update(self, update):
+        ctx = self.tracer.current_context()
+        self.slo.receive(update.doc_id)
+        return ctx
+
+    def handle_sync_message(self, msg):
+        return self.shards[0].receive_update(msg)
+
+
+def fail_path(recorder, ctx, err):
+    ctx = ctx.force("mirror_failed")
+    recorder.record(
+        "replication",
+        "mirror_failed",
+        severity="error",
+        trace=ctx,
+        detail=str(err),
+    )

@@ -1,0 +1,16 @@
+"""Suppression fixture: a real donation finding silenced by a reasoned
+inline disable — deleting the comment must reproduce it."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def step(statics, dyn):
+    return dyn
+
+
+def intentional_probe(statics, dyn):
+    out = step(statics, dyn)
+    probe = dyn.shape  # ytpu-lint: disable=donation-aliasing -- fixture: metadata-only read, shape survives donation
+    return out, probe

@@ -1,0 +1,6 @@
+"""Suppression fixture: a disable that matches nothing — the hazard is
+gone, so the comment itself is the finding."""
+
+
+def add(a, b):
+    return a + b  # ytpu-lint: disable=donation-aliasing -- fixture: nothing here to suppress

@@ -1,0 +1,11 @@
+"""Known-bad WAL kinds module: KIND_ROTATE is neither mapped in
+KIND_NAMES nor referenced by the recovery handler."""
+
+KIND_UPDATE = 1
+KIND_ACK = 2
+KIND_ROTATE = 3
+
+KIND_NAMES = {
+    KIND_UPDATE: "update",
+    KIND_ACK: "ack",
+}

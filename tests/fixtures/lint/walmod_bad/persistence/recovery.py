@@ -1,0 +1,12 @@
+"""Known-bad handler: replays UPDATE and ACK but silently skips any
+ROTATE record — the 3 a.m. recovery bug the lint front-loads."""
+
+from .records import KIND_ACK, KIND_UPDATE
+
+
+def replay(rec):
+    if rec.kind == KIND_UPDATE:
+        return "update"
+    if rec.kind == KIND_ACK:
+        return "ack"
+    return None

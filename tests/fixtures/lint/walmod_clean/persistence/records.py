@@ -1,0 +1,11 @@
+"""Known-clean WAL kinds module: every kind is mapped in KIND_NAMES."""
+
+KIND_UPDATE = 1
+KIND_ACK = 2
+KIND_ROTATE = 3
+
+KIND_NAMES = {
+    KIND_UPDATE: "update",
+    KIND_ACK: "ack",
+    KIND_ROTATE: "rotate",
+}

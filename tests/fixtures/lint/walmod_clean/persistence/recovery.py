@@ -1,0 +1,13 @@
+"""Known-clean handler: every declared kind is dispatched."""
+
+from . import records
+
+
+def replay(rec):
+    if rec.kind == records.KIND_UPDATE:
+        return "update"
+    if rec.kind == records.KIND_ACK:
+        return "ack"
+    if rec.kind == records.KIND_ROTATE:
+        return "rotate"
+    return None

@@ -257,6 +257,8 @@ class ReplicationManager:
         del kept[:-_LETTER_CAP]
         targets = self.replicas_of(guid)
         ctx = current_context()
+        if ctx is not None:
+            ctx.force("dlq_mirror")
         flight_recorder().record(
             "replication", "dlq_mirror", severity="warning", guid=guid,
             trace=ctx.trace_hex if ctx is not None else None,
@@ -275,6 +277,8 @@ class ReplicationManager:
         exclude = {owner} if owner is not None else set()
         seq = self._hwm.get(guid, 0) + 1
         ctx = current_context()
+        if ctx is not None:
+            ctx.force("absorb")
         trace_hex = (
             ctx.trace_hex if ctx is not None and ctx.sampled else None
         )

@@ -236,16 +236,20 @@ def flight_recorder() -> FlightRecorder:
     """The process-global recorder every subsystem feeds (one black box
     per process, like a real aircraft)."""
     global _RECORDER
-    if _RECORDER is None:
+    rec = _RECORDER  # ytpu-lint: disable=lock-discipline -- double-checked fast path: publication of a fully-constructed recorder is atomic under the GIL
+    if rec is None:
         with _RECORDER_LOCK:
-            if _RECORDER is None:
-                _RECORDER = FlightRecorder()
-    return _RECORDER
+            rec = _RECORDER
+            if rec is None:
+                rec = FlightRecorder()
+                _RECORDER = rec
+    return rec
 
 
 def reset_flight_recorder() -> FlightRecorder:
     """Swap in a fresh recorder (tests that assert on ring contents)."""
     global _RECORDER
     with _RECORDER_LOCK:
-        _RECORDER = FlightRecorder()
-    return _RECORDER
+        rec = FlightRecorder()
+        _RECORDER = rec
+    return rec

@@ -42,12 +42,14 @@ class FlushHistory:
 
     @property
     def maxlen(self) -> int:
-        return self._ring.maxlen
+        # the deque binding is final and .maxlen is immutable
+        return self._ring.maxlen  # ytpu-lint: disable=lock-discipline -- reads an immutable attribute of a never-rebound deque
 
     @property
     def latest(self) -> dict | None:
         """The newest entry itself — the ``last_flush_metrics`` alias."""
-        return self._ring[-1] if self._ring else None
+        with self._lock:
+            return self._ring[-1] if self._ring else None
 
     def append(self, metrics: dict) -> None:
         with self._lock:
@@ -55,13 +57,18 @@ class FlushHistory:
             self.total += 1
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def __iter__(self):
-        return iter(self._ring)
+        # iterate a point-in-time copy: deque iteration raises if a
+        # concurrent flush appends mid-walk (a torn scrape)
+        with self._lock:
+            return iter(tuple(self._ring))
 
     def __getitem__(self, i):
-        return self._ring[i]
+        with self._lock:
+            return self._ring[i]
 
     def snapshot(self) -> list[dict]:
         """Oldest-to-newest copies, safe to serialize or mutate."""

@@ -90,19 +90,25 @@ class OriginClock:
     def __init__(self, maxlen: int = 8192):
         self._t: OrderedDict = OrderedDict()
         self.maxlen = maxlen
+        # process-global instance: emitters stamp from provider threads
+        # while receivers look up — OrderedDict reorders on eviction
+        self._lock = threading.Lock()
 
     def record_once(self, key, t: float) -> None:
-        if key in self._t:
-            return
-        self._t[key] = t
-        while len(self._t) > self.maxlen:
-            self._t.popitem(last=False)
+        with self._lock:
+            if key in self._t:
+                return
+            self._t[key] = t
+            while len(self._t) > self.maxlen:
+                self._t.popitem(last=False)
 
     def lookup(self, key):
-        return self._t.get(key)
+        with self._lock:
+            return self._t.get(key)
 
     def __len__(self) -> int:
-        return len(self._t)
+        with self._lock:
+            return len(self._t)
 
 
 _ORIGINS = OriginClock()
@@ -262,7 +268,7 @@ class ConvergenceTracker:
         """A flush completed: every integrated pending update is now
         readable on this replica — close its pipeline.  Call INSIDE the
         flush span so the flow-end events bind to it in Perfetto."""
-        if not self.enabled or not self._pending:
+        if not self.enabled or not self._pending:  # ytpu-lint: disable=lock-discipline -- benign racy precheck: dict truthiness is atomic; a just-added pending closes on the next flush tick
             return 0
         if tracer is None:
             tracer = self.tracer
@@ -345,13 +351,13 @@ class ConvergenceTracker:
         """Current burn-rate verdict (``ok``/``warning``/``page``),
         re-evaluated so aged-out windows decay — cheap enough for the
         admission controller to poll every tick."""
-        if self.enabled and self._events:
+        if self.enabled and self._events:  # ytpu-lint: disable=lock-discipline -- benign racy precheck: deque truthiness is atomic; _update_state snapshots under the lock
             self._update_state()
         return self._state
 
     def snapshot(self) -> dict:
         """JSON-able SLO state (served as ``provider.slo_snapshot()``)."""
-        if self.enabled and self._events:
+        if self.enabled and self._events:  # ytpu-lint: disable=lock-discipline -- benign racy precheck: deque truthiness is atomic; _update_state snapshots under the lock
             self._update_state()  # re-evaluate: windows age out over time
         return {
             "target_ms": self.target_ms,
@@ -362,7 +368,7 @@ class ConvergenceTracker:
             "burn_rates": dict(self._burns),
             "windows": {w: dict(s) for w, s in self._windows.items()},
             "completed": self._completed,
-            "pending": len(self._pending),
+            "pending": len(self._pending),  # ytpu-lint: disable=lock-discipline -- point-in-time gauge: len() of a dict is atomic under the GIL
         }
 
 

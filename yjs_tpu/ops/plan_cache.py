@@ -101,6 +101,8 @@ def poison_frontier() -> bytes:
     from ..obs.dist import current_context
 
     ctx = current_context()
+    if ctx is not None:
+        ctx.force("frontier_poisoned")
     flight_recorder().record(
         "plan_cache", "frontier_poisoned", severity="warning",
         trace=ctx.trace_hex if ctx is not None else None,

@@ -389,6 +389,8 @@ class TpuProvider:
                 # flight recorder, and a dump ships the forensics (the
                 # recorder dedupes, so a rejection burst emits one file)
                 ctx = obs_dist.current_context()
+                if ctx is not None:
+                    ctx.force("provider_full")
                 bb = self.engine.obs.blackbox
                 bb.record(
                     "provider", "full", severity="error", guid=guid,
