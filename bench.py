@@ -1700,6 +1700,204 @@ def bench_tiering(n_ops: int = 200) -> dict:
     return out
 
 
+def bench_cluster() -> dict:
+    """Process-native cluster cost (ISSUE 14): the SAME y-websocket
+    gateway runs over real OS-process shards (Supervisor + RPC) and
+    over the in-process fleet (LocalCluster), and two raw-session
+    clients in one room measure end-to-end convergence per edit —
+    insert on A until visible on B — so the p50/p99 delta IS the
+    process-fabric tax (socket hops + serialization + per-shard
+    GIL isolation).  Then the process run's owner shard takes a
+    ``kill -9`` and the block reports the unavailability window: the
+    supervisor's detected outage (``unavailable_s`` on the recovery
+    event) and the wall-clock until both peers reconverge with the
+    outage edit, plus the restart/resolution counters federated from
+    the snapshot directory the monitor dropped (the same files
+    ``ytpu_top --cluster`` tails).
+
+    The block is also written to BENCH_cluster.json.
+    """
+    import signal
+    import socket as socketlib
+    import tempfile
+
+    import yjs_tpu as Y
+    from yjs_tpu.cluster import (
+        ClusterConfig, Gateway, GatewayConfig, LocalCluster, Supervisor,
+    )
+    from yjs_tpu.cluster.rpc import RpcError
+    from yjs_tpu.fleet import FleetRouter
+    from yjs_tpu.obs.federate import federate_snapshots, read_snapshot_dir
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent / "examples")
+    )
+    from socket_connector import SocketConnector
+
+    n_shards = int(os.environ.get("YTPU_BENCH_CLUSTER_SHARDS", "3"))
+    n_edits = int(os.environ.get("YTPU_BENCH_CLUSTER_EDITS", "30"))
+    room = "bench-room"
+
+    def pct(samples, p):
+        s = sorted(samples)
+        return round(s[min(len(s) - 1, int(p * len(s)))], 2)
+
+    def connect(port, client_id):
+        doc = Y.Doc(gc=False)
+        doc.client_id = client_id
+        sock = socketlib.create_connection(("127.0.0.1", port), timeout=30)
+        conn = SocketConnector(doc, sock, room=room, peer=f"p{client_id}")
+        conn.connect()
+        return doc, conn
+
+    def edit_until_visible(a, b, token, deadline_s=60.0):
+        """Insert ``token`` on A; wall ms until B's replica shows it."""
+        doc_a, conn_a = a
+        doc_b, conn_b = b
+        t0 = time.perf_counter()
+        with conn_a.lock:
+            doc_a.get_text("text").insert(0, token)
+        deadline = t0 + deadline_s
+        while time.perf_counter() < deadline:
+            with conn_b.lock:
+                if token in doc_b.get_text("text").to_string():
+                    return (time.perf_counter() - t0) * 1000.0
+            time.sleep(0.002)
+        raise TimeoutError(f"{token} never converged")
+
+    def run_fabric(kind, wd):
+        snap_dir = os.path.join(wd, "snap")
+        if kind == "process":
+            cluster = Supervisor(
+                n_shards, os.path.join(wd, "wal"), docs_per_shard=8,
+                config=ClusterConfig(
+                    heartbeat_s=0.15, restart_backoff_s=0.05,
+                    busy_retry_ticks=4, restart_max=2,
+                    snapshot_dir=snap_dir, snapshot_s=0.5,
+                ),
+            ).start()
+        else:
+            cluster = LocalCluster(FleetRouter(
+                n_shards=n_shards, docs_per_shard=8, backend="cpu",
+                wal_dir=os.path.join(wd, "wal"),
+            ))
+        gw = Gateway(cluster, config=GatewayConfig(port=0)).start()
+        out = {"kind": kind}
+        pairs = []
+        try:
+            t0 = time.perf_counter()
+            a = connect(gw.port, 1)
+            b = connect(gw.port, 2)
+            pairs = [a, b]
+            edit_until_visible(a, b, "[warm]")  # handshake + first flush
+            out["connect_ms"] = round(
+                (time.perf_counter() - t0) * 1000.0, 1
+            )
+            lat = [
+                edit_until_visible(a, b, f"[e{i}]")
+                for i in range(n_edits)
+            ]
+            out["edits"] = n_edits
+            out["converge_ms_p50"] = pct(lat, 0.50)
+            out["converge_ms_p99"] = pct(lat, 0.99)
+
+            if kind == "process":
+                owner = cluster.owner_of(room)
+                pid = cluster._shards[owner].pid
+                k0 = time.perf_counter()
+                os.kill(pid, signal.SIGKILL)
+                # the outage edit: BUSY-held in the session outbox
+                # until the restarted shard serves again
+                reconverge_ms = edit_until_visible(
+                    a, b, "[outage]", deadline_s=120.0
+                )
+                report = cluster.recovery_report()
+                deadline = time.time() + 60
+                while not report["events"] and time.time() < deadline:
+                    time.sleep(0.1)
+                    report = cluster.recovery_report()
+                ev = report["events"][0] if report["events"] else {}
+                resyncs = []
+                for doc, conn in pairs:
+                    with conn.lock:
+                        resyncs.append(
+                            conn.session.snapshot()["full_resyncs"]
+                        )
+                out["kill9"] = {
+                    "outcome": ev.get("outcome"),
+                    "unavailable_s": round(
+                        float(ev.get("unavailable_s") or 0.0), 3
+                    ),
+                    "reconverge_s": round(reconverge_ms / 1000.0, 3),
+                    "kill_to_visible_s": round(
+                        time.perf_counter() - k0, 3
+                    ),
+                    "full_resyncs_max": max(resyncs),
+                }
+                # the monitor's periodic file drop, federated exactly
+                # the way ytpu_top --cluster consumes it
+                deadline = time.time() + 15
+                while time.time() < deadline and not os.path.exists(
+                    os.path.join(snap_dir, "cluster.json")
+                ):
+                    time.sleep(0.1)
+                sources = [
+                    s for s in read_snapshot_dir(snap_dir)
+                    if s["label"] != "cluster"
+                ]
+                fed = federate_snapshots(sources)
+                try:
+                    with open(
+                        os.path.join(snap_dir, "cluster.json")
+                    ) as f:
+                        dropped = json.load(f)
+                except (OSError, ValueError):
+                    dropped = {}
+                out["federated"] = {
+                    "sources": fed["federation"]["sources"],
+                    "wal_records_appended_total": round(sum(
+                        fed["counters"]
+                        .get("ytpu_wal_records_appended_total", {})
+                        .values()
+                    )),
+                    "report_outcomes": dropped.get("outcomes", {}),
+                    "report_epoch": dropped.get("epoch"),
+                }
+        finally:
+            for doc, conn in pairs:
+                try:
+                    conn.close()
+                except (OSError, RpcError):
+                    pass
+            gw.close()
+            cluster.close()
+        return out
+
+    with tempfile.TemporaryDirectory(prefix="ytpu-bench-clu") as wd_p:
+        process = run_fabric("process", wd_p)
+    with tempfile.TemporaryDirectory(prefix="ytpu-bench-clu") as wd_l:
+        inprocess = run_fabric("inprocess", wd_l)
+
+    out = {
+        "n_shards": n_shards,
+        "process": process,
+        "inprocess": inprocess,
+        "process_tax_p50": (
+            round(
+                process["converge_ms_p50"]
+                / max(1e-9, inprocess["converge_ms_p50"]),
+                2,
+            )
+        ),
+    }
+    try:
+        with open("BENCH_cluster.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -1765,6 +1963,8 @@ def main():
     failover = bench_failover()
     time.sleep(3)
     overload = bench_overload()
+    time.sleep(3)
+    cluster = bench_cluster()
     time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
@@ -1842,6 +2042,7 @@ def main():
             "tiering": tiering,
             "failover": failover,
             "overload": overload,
+            "cluster": cluster,
         },
     }
     if sweep is not None:

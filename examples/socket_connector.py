@@ -11,6 +11,15 @@ frames stay exactly what a JS ``y-websocket`` peer would exchange: a
 peer that never speaks the session envelope is detected by its bare
 step 1 and the session negotiates down to the plain protocol.
 
+Since ISSUE 14 the socket plumbing is the cluster's own
+:class:`~yjs_tpu.cluster.rpc.SocketTransport` — the same rx/tx thread
+pair the shard RPC rides — whose ``close()`` contract is drain-then-
+join: every frame accepted before close reaches the wire, then both
+threads exit (``tests/test_connector.py`` pins this).  Passing
+``room=`` sends the raw-dialect preamble, which makes this connector a
+ready-made client for the cluster gateway
+(``yjs_tpu.cluster.gateway``).
+
 Run in two terminals (the first becomes the listener):
 
     python examples/socket_connector.py server 47800
@@ -24,17 +33,16 @@ y-protocols/sync.js (the message flow the protocol module mirrors).
 from __future__ import annotations
 
 import os
-import queue
 import socket
-import struct
 import sys
 import threading
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import yjs_tpu as Y
+from yjs_tpu.cluster.gateway import encode_room_preamble
+from yjs_tpu.cluster.rpc import SocketTransport
 from yjs_tpu.sync.session import DocSessionHost, SessionConfig, SyncSession
-from yjs_tpu.sync.transport import CallbackTransport
 from yjs_tpu.utils.abstract_connector import AbstractConnector
 
 # seconds of wall time per session tick: with the default knobs that
@@ -46,69 +54,57 @@ TICK_SECONDS = 0.05
 class SocketConnector(AbstractConnector):
     """Bind one doc to one TCP peer through a resumable session.
 
-    The Doc is NOT thread-safe; the receive and ticker threads drive
-    the session under ``self.lock``, and local edits from other threads
-    must take the same lock (see ``_demo``)."""
+    The Doc is NOT thread-safe; the transport delivers frames and the
+    ticker thread drives the session under ``self.lock``, and local
+    edits from other threads must take the same lock (see ``_demo``)."""
 
     def __init__(
         self, ydoc: Y.Doc, sock: socket.socket, awareness=None,
         config: SessionConfig | None = None,
+        room: str | None = None, peer: str | None = None,
     ):
         super().__init__(ydoc, awareness)
         self._sock = sock
-        self._send_lock = threading.Lock()
         #: guards every doc access (remote applies, local edits, reads)
         self.lock = threading.RLock()
         self._closed = False
-        # outbound frames ride a queue drained by a writer thread: the
-        # update handler fires while the editor holds self.lock, and
-        # blocking in sendall there would deadlock two back-pressured
-        # peers whose rx threads both wait on that lock
-        self._outbox: "queue.Queue[bytes | None]" = queue.Queue()
-        self._transport = CallbackTransport(self._enqueue)
+        peer_name = peer or f"fd{sock.fileno()}"
+        # the transport owns the rx/tx threads; inbound frames are
+        # delivered under self.lock (the session is not thread-safe)
+        self._transport = SocketTransport(
+            sock, frame_lock=self.lock, name=peer_name
+        )
+        self.room = room
         self.session = SyncSession(
             DocSessionHost(ydoc, origin=self),
             config=config,
-            peer=f"fd{sock.fileno()}",
+            peer=peer_name,
         )
         ydoc.on("update", self._on_local_update)
-        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
-        self._tx = threading.Thread(target=self._send_loop, daemon=True)
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
-
-    # -- framing ------------------------------------------------------------
-
-    def _enqueue(self, payload: bytes) -> None:
-        self._outbox.put(bytes(payload))  # never blocks the editor
-
-    def _send(self, payload: bytes) -> None:
-        with self._send_lock:
-            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
-
-    def _recv(self) -> bytes | None:
-        hdr = b""
-        while len(hdr) < 4:
-            chunk = self._sock.recv(4 - len(hdr))
-            if not chunk:
-                return None
-            hdr += chunk
-        (n,) = struct.unpack("<I", hdr)
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
 
     # -- sync flow ----------------------------------------------------------
 
     def connect(self) -> None:
-        """Start the session handshake and the rx/tx/ticker threads."""
+        """Start the session handshake and the transport/ticker threads."""
         with self.lock:
+            if self.room is not None:
+                # the gateway's raw-dialect hello MUST be the first
+                # frame on the wire; it is queued ahead of the HELLO
+                # that attach() emits, and the drained-in-order tx
+                # thread preserves that
+                self._transport.send(encode_room_preamble(
+                    self.room, self.session.peer
+                ))
             self.session.connect(self._transport)
-        self._rx.start()
-        self._tx.start()
+            inner_close = self._transport.on_close
+            def _closed(_cb=inner_close):
+                if _cb is not None:
+                    _cb()
+                self.emit("close", [])
+                self.on_disconnect("eof")
+            self._transport.on_close = _closed
+        self._transport.start()
         self._ticker.start()
         self.on_connect()
 
@@ -119,39 +115,13 @@ class SocketConnector(AbstractConnector):
         with self.lock:
             self.session.send_update(update)
 
-    def _send_loop(self) -> None:
-        try:
-            while True:
-                payload = self._outbox.get()
-                if payload is None:
-                    break
-                self._send(payload)
-        except OSError as e:
-            self.on_error(e)  # peer vanished: rx loop emits the close
-
-    def _recv_loop(self) -> None:
-        reason = "eof"
-        try:
-            while not self._closed:
-                payload = self._recv()
-                if payload is None:
-                    break
-                with self.lock:
-                    self._transport.deliver(payload)
-        except (OSError, ValueError) as e:
-            reason = f"error: {type(e).__name__}"
-            self.on_error(e)
-        finally:
-            self.emit("close", [])
-            self.on_disconnect(reason)
-
     def _tick_loop(self) -> None:
         # session time advances on a fixed wall cadence; everything the
         # tick drives (retransmit backoff, heartbeats, liveness, the
         # anti-entropy digests) counts in these ticks
         import time
 
-        while not self._closed:
+        while True:
             time.sleep(TICK_SECONDS)
             with self.lock:
                 if self._closed:
@@ -159,19 +129,37 @@ class SocketConnector(AbstractConnector):
                 self.session.tick()
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self.doc.off("update", self._on_local_update)
+        """Shutdown contract (pinned by ``tests/test_connector.py``):
+        stop the ticker, stop inbound delivery, then let the transport
+        drain its outbox to the wire and JOIN both of its threads —
+        nothing accepted before close is dropped.  Frames the peer
+        never acked stay in the session outbox for the next attach."""
         with self.lock:
-            self.session.close()
-        self._outbox.put(None)  # unblock the writer thread
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self._sock.close()
+            if self._closed:
+                return
+            self._closed = True
+        self.doc.off("update", self._on_local_update)
+        me = threading.current_thread()
+        if self._ticker.is_alive() and self._ticker is not me:
+            self._ticker.join(timeout=2.0)
+        with self.lock:
+            # no more inbound deliveries race the teardown; the rx
+            # thread drains to EOF on its own
+            self._transport.on_frame = None
+        # session.close() closes the transport: drain outbox → join tx
+        # → close socket → join rx → single on_close
+        self.session.close()
         self.on_disconnect("closed")
+
+    def join(self, timeout: float = 2.0) -> bool:
+        """True when the ticker and both transport threads exited."""
+        me = threading.current_thread()
+        if self._ticker.is_alive() and self._ticker is not me:
+            self._ticker.join(timeout=timeout)
+        transport_done = self._transport.join(timeout=timeout)
+        return transport_done and not (
+            self._ticker.is_alive() and self._ticker is not me
+        )
 
 
 def _demo(role: str, port: int) -> None:

@@ -22,6 +22,13 @@ echo "== trace validity (check_trace selftest) =="
 # sampled chain completes origin -> visible (ISSUE 11)
 python scripts/check_trace.py --selftest
 
+echo "== cluster smoke (marker: cluster) =="
+# the process-native cluster suite (ISSUE 14) is the newest subsystem:
+# real OS-process shards behind the y-websocket gateway — kill -9
+# recovery, replica failover, wire-compat, launcher, and supervision
+# panel regressions surface fast and isolated
+python -m pytest tests/ -q -m 'cluster and not slow' -p no:cacheprovider
+
 echo "== analysis smoke (marker: analysis) =="
 # the ytpu-lint framework suite (ISSUE 13): fixture corpus, suppression
 # and baseline round-trips, and the whole-repo self-run

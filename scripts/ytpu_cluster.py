@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Launch a process-native y-tpu cluster from the CLI (ISSUE 14).
+
+Spawns N shard processes under a :class:`~yjs_tpu.cluster.Supervisor`
+and fronts them with the y-websocket-compatible
+:class:`~yjs_tpu.cluster.Gateway`, then runs until SIGINT/SIGTERM —
+the operator-facing equivalent of the acceptance suite's topology.
+
+Shape of a run::
+
+    python scripts/ytpu_cluster.py --shards 3 --gateway 8765
+    python scripts/ytpu_cluster.py --config cluster.json
+    python scripts/ytpu_cluster.py --shards 1 --smoke   # CI round-trip
+
+``--config`` takes a **docker-compose-shaped** JSON file, so the same
+topology description moves between this launcher and a real compose
+deployment without translation::
+
+    {
+      "services": {
+        "shard": {
+          "deploy": {"replicas": 3},
+          "environment": {"YTPU_CLUSTER_HEARTBEAT_S": "0.25"}
+        },
+        "gateway": {
+          "ports": ["8765:8765"],
+          "environment": {"YTPU_GATEWAY_TICK_S": "0.05"}
+        }
+      }
+    }
+
+``services.shard.deploy.replicas`` is the shard count,
+``services.gateway.ports[0]`` ("HOST:CONTAINER" or a bare port) is the
+gateway port, and each service's ``environment`` map is applied to
+``os.environ`` before the ``YTPU_CLUSTER_*`` / ``YTPU_GATEWAY_*``
+configs are constructed (shard children inherit it).  CLI flags win
+over the config file.
+
+``--smoke`` connects one raw-session client through the gateway, makes
+an edit, waits for the acked round-trip, verifies the text server-side,
+and exits 0/1 — the one-shot health probe `scripts/ci_check.sh` runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_compose(cfg: dict) -> dict:
+    """Flatten a docker-compose-shaped dict into launcher settings:
+    ``{"shards": int | None, "gateway_port": int | None, "env": dict}``.
+    Unknown services/keys are ignored (the file may drive a real
+    compose deployment with more in it)."""
+    out = {"shards": None, "gateway_port": None, "env": {}}
+    services = cfg.get("services") or {}
+    shard = services.get("shard") or {}
+    deploy = shard.get("deploy") or {}
+    if "replicas" in deploy:
+        out["shards"] = int(deploy["replicas"])
+    gateway = services.get("gateway") or {}
+    ports = gateway.get("ports") or []
+    if ports:
+        # compose publishes "HOST:CONTAINER"; the host side is ours
+        host_port = str(ports[0]).split(":", 1)[0]
+        out["gateway_port"] = int(host_port)
+    for svc in (shard, gateway):
+        env = svc.get("environment") or {}
+        if isinstance(env, list):  # compose's KEY=VALUE list form
+            env = dict(e.split("=", 1) for e in env if "=" in e)
+        out["env"].update({str(k): str(v) for k, v in env.items()})
+    return out
+
+
+def _smoke(gw, sup) -> int:
+    """One edit through the gateway's session dialect, verified
+    server-side — exits nonzero unless the acked round-trip lands."""
+    import socket as socketlib
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples",
+        ),
+    )
+    from socket_connector import SocketConnector
+
+    import yjs_tpu as Y
+
+    room, text = "smoke-room", "cluster smoke ok"
+    doc = Y.Doc()
+    sock = socketlib.create_connection(("127.0.0.1", gw.port), timeout=30)
+    conn = SocketConnector(doc, sock, room=room, peer="smoke-client")
+    try:
+        conn.connect()
+        with conn.lock:
+            doc.get_text("text").insert(0, text)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if sup.text(room) == text:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        else:
+            print("smoke: FAILED (edit never landed)", file=sys.stderr)
+            return 1
+        with conn.lock:
+            snap = conn.session.snapshot()
+        if snap.get("outbox_depth"):
+            time.sleep(0.5)  # let the ack drain before judging
+            with conn.lock:
+                snap = conn.session.snapshot()
+        print(
+            "smoke: OK room=%r text=%r outbox=%s"
+            % (room, text, snap.get("outbox_depth"))
+        )
+        return 0
+    finally:
+        conn.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--shards", type=int, default=None,
+                    help="shard process count (default 3)")
+    ap.add_argument("--gateway", type=int, default=None, metavar="PORT",
+                    help="gateway TCP port (default 0 = ephemeral)")
+    ap.add_argument("--config", default=None, metavar="FILE",
+                    help="docker-compose-shaped JSON topology file")
+    ap.add_argument("--wal-root", default=None, metavar="DIR",
+                    help="per-shard WAL root (default: a temp dir)")
+    ap.add_argument("--snapshot-dir", default=None, metavar="DIR",
+                    help="federated snapshot dir for ytpu_top --cluster")
+    ap.add_argument("--docs-per-shard", type=int, default=64)
+    ap.add_argument("--smoke", action="store_true",
+                    help="one edit round-trip through the gateway, "
+                         "then exit 0/1")
+    args = ap.parse_args(argv)
+
+    shards, gw_port = args.shards, args.gateway
+    if args.config:
+        with open(args.config) as f:
+            compose = parse_compose(json.load(f))
+        os.environ.update(compose["env"])
+        if shards is None:
+            shards = compose["shards"]
+        if gw_port is None:
+            gw_port = compose["gateway_port"]
+    shards = 3 if shards is None else shards
+    if shards < 1:
+        ap.error("--shards must be >= 1")
+
+    # env must be settled before the configs read it
+    from yjs_tpu.cluster import (
+        ClusterConfig, Gateway, GatewayConfig, Supervisor,
+    )
+
+    wal_root = args.wal_root or tempfile.mkdtemp(prefix="ytpu-cluster-")
+    cconfig = ClusterConfig(
+        snapshot_dir=args.snapshot_dir
+        if args.snapshot_dir is not None else None,
+    )
+    gconfig = GatewayConfig(port=gw_port)
+
+    sup = Supervisor(
+        shards, wal_root, docs_per_shard=args.docs_per_shard, config=cconfig
+    ).start()
+    gw = Gateway(sup, config=gconfig).start()
+    print(
+        "ytpu-cluster: %d shard(s) up, gateway on %s:%d, wal-root %s"
+        % (shards, gw.config.host, gw.port, wal_root)
+    )
+    for row in sup.recovery_report()["shards"]:
+        print(
+            "  shard %(shard)d: %(state)s pid=%(pid)s port=%(port)s" % row
+        )
+
+    if args.smoke:
+        try:
+            return _smoke(gw, sup)
+        finally:
+            gw.close()
+            sup.close()
+
+    stop = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.25)
+    finally:
+        print("ytpu-cluster: shutting down")
+        gw.close()
+        sup.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,14 @@ Sources (one row per provider):
         Run two in-process providers exchanging sync traffic, one frame
         of fresh edits per poll — the zero-to-dashboard smoke test.
 
+    python scripts/ytpu_top.py --cluster /path/to/snapshot-dir/
+        Cluster mode (ISSUE 14): the directory is a supervisor snapshot
+        drop (``Supervisor.dump_snapshots`` / YTPU_CLUSTER_SNAPSHOT_DIR)
+        — ``shard-K.json`` metric snapshots federate as in directory
+        mode, and ``cluster.json`` (the structured recovery report)
+        renders as a supervision panel above them: per-shard process
+        state, restart counts, replay outcomes, and the event tail.
+
 Renders with curses on a tty, plain text otherwise (or with ``--plain``);
 ``--once`` prints a single frame and exits (scripting / CI).
 """
@@ -333,6 +341,91 @@ class DirSource:
         return out
 
 
+CLUSTER_COLUMNS = (
+    ("shard", 6),
+    ("state", 11),
+    ("pid", 8),
+    ("port", 6),
+    ("restarts", 9),
+    ("outcome", 10),
+    ("replayed", 9),
+)
+
+
+def render_cluster(report: dict) -> str:
+    """The supervision panel: one row per shard process plus the
+    resolution totals and the last few restart/failover events."""
+    if not report:
+        return "cluster: no cluster.json yet\n"
+    out = [
+        f"cluster epoch {report.get('epoch', 0)}  "
+        f"outcomes {report.get('outcomes', {})}  "
+        f"resolution {report.get('resolution', {})}"
+    ]
+    out.append(
+        "  ".join(f"{title:>{w}}" for title, w in CLUSTER_COLUMNS)
+    )
+    for row in report.get("shards", ()):
+        vals = {
+            "shard": row.get("shard", "?"),
+            "state": row.get("state", "?"),
+            "pid": row.get("pid", 0),
+            "port": row.get("port", 0),
+            "restarts": row.get("restarts", 0),
+            "outcome": row.get("outcome", ""),
+            "replayed": row.get("records_applied", 0),
+        }
+        out.append(
+            "  ".join(
+                f"{str(vals[title]):>{w}}" for title, w in CLUSTER_COLUMNS
+            )
+        )
+    for ev in (report.get("events") or [])[-3:]:
+        out.append(
+            f"  event: shard {ev.get('shard')} {ev.get('outcome')} "
+            f"epoch={ev.get('epoch')} "
+            f"unavailable={ev.get('unavailable_s')}s "
+            f"resolution={ev.get('resolution')}"
+        )
+    return "\n".join(out) + "\n"
+
+
+class ClusterDirSource:
+    """Supervisor snapshot-dir mode (``--cluster``): ``shard-*.json``
+    federate like :class:`DirSource`, ``cluster.json`` feeds the
+    supervision panel via :meth:`header`."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def _report(self) -> dict:
+        try:
+            with open(Path(self.path) / "cluster.json") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}  # mid-write or not dumped yet: empty panel
+
+    def header(self) -> str:
+        return render_cluster(self._report())
+
+    def poll(self) -> list[tuple[str, dict]]:
+        from yjs_tpu.obs.federate import (
+            federate_snapshots,
+            read_snapshot_dir,
+        )
+
+        sources = [
+            s for s in read_snapshot_dir(self.path)
+            if str(s.get("label", "")) != "cluster"
+        ]
+        out = [("CLUSTER", federate_snapshots(sources))]
+        for src in sources:
+            out.append(
+                (str(src.get("label", "?")), src.get("snapshot") or {})
+            )
+        return out
+
+
 class DemoSource:
     """Two in-process providers joined by per-room peer sessions over
     an in-memory pipe; every poll applies one fresh edit and pumps the
@@ -389,6 +482,9 @@ def run_plain(source, interval: float, iterations: int | None = None,
             for name, snap in source.poll()
         ]
         prev = {r["provider"]: r for r in rows}
+        header = getattr(source, "header", None)
+        if header is not None:
+            out.write(header())
         out.write(render(rows, interval))
         out.flush()
         n += 1
@@ -408,7 +504,11 @@ def run_curses(source, interval: float) -> None:  # pragma: no cover - tty
             ]
             prev = {r["provider"]: r for r in rows}
             scr.erase()
-            for y, line in enumerate(render(rows, interval).splitlines()):
+            header = getattr(source, "header", None)
+            frame = (header() if header is not None else "") + render(
+                rows, interval
+            )
+            for y, line in enumerate(frame.splitlines()):
                 try:
                     scr.addnstr(y, 0, line, curses.COLS - 1)
                 except curses.error:
@@ -434,6 +534,10 @@ def main(argv=None) -> int:
                          "federate")
     ap.add_argument("--demo", action="store_true",
                     help="dashboard over two in-process demo providers")
+    ap.add_argument("--cluster", action="store_true",
+                    help="treat the directory argument as a supervisor "
+                         "snapshot drop and render the cluster.json "
+                         "supervision panel above the shard rows")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
@@ -444,6 +548,10 @@ def main(argv=None) -> int:
 
     if args.demo:
         source = DemoSource()
+    elif args.cluster:
+        if len(args.snapshots) != 1 or not Path(args.snapshots[0]).is_dir():
+            ap.error("--cluster requires ONE snapshot directory")
+        source = ClusterDirSource(args.snapshots[0])
     elif len(args.snapshots) == 1 and Path(args.snapshots[0]).is_dir():
         source = DirSource(args.snapshots[0])
     elif args.snapshots:

@@ -140,6 +140,16 @@ def pytest_configure(config):
         "markers",
         "analysis: ytpu-lint checker, suppression, and baseline tests",
     )
+    # "cluster" tags the process-native cluster suite (ISSUE 14) — in
+    # tier-1 by default (real OS processes on loopback sockets, tmp-dir
+    # WALs; it spawns real shard subprocesses so it is among the slower
+    # marker suites), deselectable with -m 'not cluster'; ci_check.sh
+    # also runs it standalone first
+    config.addinivalue_line(
+        "markers",
+        "cluster: multiprocess shard supervisor, RPC fabric, and "
+        "y-websocket gateway tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -1,0 +1,15 @@
+"""Known-bad: the cluster's cross-process ingress seams with neither a
+TraceContext, nor an SLO feed, nor a delegation to another seam — a
+frame entering here is invisible to causal tracing and never counts
+against the convergence objective."""
+
+
+class Shard:
+    def handle_rpc_request(self, method, payload, ctx):  # BAD
+        self.log.append((method, payload))
+        return {"ok": True}
+
+
+class GatewayConn:
+    def handle_client_message(self, data):  # BAD
+        self.frames.append(bytes(data))

@@ -1,0 +1,28 @@
+"""Known-clean cluster seams: the RPC ingress adopts the carried
+context and delegates data traffic to the provider's own seam; the
+gateway ingress adopts-or-mints and routes through the cluster facade
+(itself a seam), via a same-class private helper — the checker
+searches helpers one level deep."""
+
+
+class Shard:
+    def handle_rpc_request(self, method, payload, ctx):
+        with self.obs.use_context(ctx):
+            return self._dispatch(method, payload)
+
+    def _dispatch(self, method, payload):
+        if method == "update":
+            return self.provider.receive_update(payload["guid"],
+                                                payload["update"])
+        return self.provider.handle_sync_message(payload["guid"],
+                                                 payload["frame"])
+
+
+class GatewayConn:
+    def handle_client_message(self, data):
+        ctx = self.obs.current_context() or self.obs.mint_for_update(data)
+        with self.obs.use_context(ctx):
+            self._dispatch_client(data)
+
+    def _dispatch_client(self, data):
+        return self.cluster.handle_sync_message(self.room, data)

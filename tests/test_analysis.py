@@ -72,6 +72,8 @@ BAD = [
     ("locks_unguarded_read.py", [RULE_DISCIPLINE]),
     ("locks_ordering_cycle.py", [RULE_ORDERING]),
     ("seams_bad_ingress.py", [RULE_TRACE, RULE_TRACE]),
+    ("seams_bad_cluster_ingress.py",
+     [RULE_TRACE, RULE_TRACE, RULE_TRACE, RULE_TRACE]),
     ("seams_bad_force.py", [RULE_FORCE]),
 ]
 
@@ -80,6 +82,7 @@ CLEAN = [
     "retrace_clean.py",
     "locks_clean.py",
     "seams_clean.py",
+    "seams_clean_cluster.py",
 ]
 
 

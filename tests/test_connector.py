@@ -82,3 +82,53 @@ def test_socket_connector_two_peer_convergence():
     assert ma == mb == {"k": 7}
     ca.close()
     cb.close()
+
+
+def test_socket_connector_close_joins_threads_without_dropping_frames():
+    """The satellite-1 shutdown pin: ``close()`` drains every frame the
+    session handed the transport before the FIN hits the wire, and the
+    ticker plus both transport threads JOIN — no leaked threads, no
+    dropped unacked frames."""
+    a_sock, b_sock = socket.socketpair()
+    da = Y.Doc(gc=False)
+    da.client_id = 1
+    db = Y.Doc(gc=False)
+    db.client_id = 2
+
+    ca = SocketConnector(da, a_sock)
+    cb = SocketConnector(db, b_sock)
+    ca.connect()
+    cb.connect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with ca.lock:
+            live = ca.session.state == "live"
+        if live:
+            break
+        time.sleep(0.02)
+    with ca.lock:
+        assert ca.session.state == "live"
+
+    # edit, then close immediately: the DATA frame is in the transport
+    # outbox, not yet on the wire — close must flush it, not drop it
+    with ca.lock:
+        da.get_text("text").insert(0, "final words")
+    ca.close()
+
+    assert ca.join(timeout=5.0), "connector threads did not join on close"
+    assert not ca._transport._tx.is_alive()
+    assert not ca._transport._rx.is_alive()
+    assert not ca._ticker.is_alive()
+    assert ca._transport.queued == 0, "close dropped queued frames"
+
+    # the peer (still open) receives the pre-close frame
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with cb.lock:
+            tb = db.get_text("text").to_string()
+        if tb == "final words":
+            break
+        time.sleep(0.05)
+    assert tb == "final words", f"peer saw {tb!r}"
+    cb.close()
+    assert cb.join(timeout=5.0)

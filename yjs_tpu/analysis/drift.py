@@ -42,7 +42,7 @@ RULE_METRIC = "metric-drift"
 KNOB_PREFIXES = (
     "CHAOS", "RESILIENCE", "DLQ", "WAL", "PROF", "SLO", "NET", "FLEET",
     "TIER", "REPL", "FAILOVER", "PLAN", "ADM", "TRACE", "BLACKBOX",
-    "FLUSH", "LINT",
+    "FLUSH", "LINT", "CLUSTER", "GATEWAY",
 )
 
 KNOB_RE = re.compile(

@@ -2,7 +2,9 @@
 seam must honor, machine-checked.
 
 - **seam-trace** — every ingress seam (any method named
-  ``receive_update`` / ``handle_sync_message``) must adopt-or-mint a
+  ``receive_update`` / ``handle_sync_message``, or the cluster's
+  cross-process entry points ``handle_rpc_request`` /
+  ``handle_client_message``) must adopt-or-mint a
   TraceContext (a call to ``_trace_ingress`` / ``current_context`` /
   ``mint_for_update`` / ``use_context``) AND feed the SLO pipeline
   (``…slo.receive/origin/…``) — or visibly delegate to another seam
@@ -31,7 +33,16 @@ RULE_TRACE = "seam-trace"
 RULE_WAL_KIND = "seam-wal-kind"
 RULE_FORCE = "seam-force-sample"
 
-INGRESS_METHODS = frozenset({"receive_update", "handle_sync_message"})
+INGRESS_METHODS = frozenset(
+    {
+        "receive_update",
+        "handle_sync_message",
+        # the process-native cluster's ingress seams: every frame that
+        # crosses a process boundary enters through one of these
+        "handle_rpc_request",
+        "handle_client_message",
+    }
+)
 TRACE_ESTABLISHERS = frozenset(
     {"_trace_ingress", "current_context", "mint_for_update", "use_context"}
 )

@@ -1136,6 +1136,52 @@ class FleetRouter:
         snap["admission"] = self.admission.snapshot()
         return snap
 
+    def recovery_report(self) -> dict:
+        """Per-shard recovery outcomes in the SAME structured shape the
+        cluster :class:`~yjs_tpu.cluster.supervisor.Supervisor` reports
+        (ISSUE 14 satellite): one row per shard with its replay
+        outcome, plus the ownership-resolution totals from the last
+        :meth:`recover`.  A fleet built fresh reports every shard as
+        ``fresh`` with zeroed resolutions — ``ytpu_top --cluster``
+        renders both identically."""
+        rec = self.last_recovery or {}
+        shard_stats = rec.get("shards") or []
+        rows = []
+        for k, p in enumerate(self.shards):
+            stats = (
+                shard_stats[k] if k < len(shard_stats) else None
+            ) or p.last_recovery or {}
+            if self._is_stub(k):
+                state = "lost"
+            elif k in self._down:
+                state = "down"
+            else:
+                state = "live"
+            rows.append({
+                "shard": k,
+                "state": state,
+                "pid": os.getpid(),
+                "port": 0,
+                "restarts": 0,
+                "outcome": "recovered" if stats else "fresh",
+                # replayed work: tail records plus checkpoint snapshots
+                # (a gracefully-closed shard restores from its snapshot)
+                "records_applied": stats.get("records_applied", 0)
+                + stats.get("snapshots_applied", 0),
+            })
+        resolution = dict(rec.get("resolution") or {})
+        for kind in ("completed", "aborted", "fenced"):
+            resolution.setdefault(kind, 0)
+        recovered = sum(1 for r in rows if r["outcome"] == "recovered")
+        return {
+            "kind": "fleet",
+            "epoch": self.table.epoch,
+            "shards": rows,
+            "events": [],
+            "outcomes": {"recovered": recovered, "failover": 0},
+            "resolution": resolution,
+        }
+
     # -- recovery ------------------------------------------------------------
 
     @classmethod
