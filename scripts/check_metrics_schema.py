@@ -48,6 +48,15 @@ def registered_names() -> set[str]:
     # documented contract too
     FleetRouter(1, 1)
     register_lint_metric()
+    # cluster families are lazily-registered process-global singletons —
+    # touch each holder so the live set includes them
+    from yjs_tpu.cluster.gateway import _GatewayMetricsSingleton
+    from yjs_tpu.cluster.rpc import rpc_metrics
+    from yjs_tpu.cluster.supervisor import _ClusterMetrics
+
+    _GatewayMetricsSingleton.get()
+    rpc_metrics()
+    _ClusterMetrics()
     return set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

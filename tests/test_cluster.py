@@ -256,6 +256,82 @@ def test_failover_promotes_replica_past_restart_budget(tmp_path):
         sup.close()
 
 
+def test_hung_shard_convicted_by_heartbeat_probe(tmp_path):
+    """A shard whose process is alive and socket open but which stopped
+    serving (here: SIGSTOP) is invisible to ``proc.poll()`` and
+    ``client.alive`` — only the monitor's heartbeat probe can convict
+    it.  Two unanswered probes must force a restart-through-recover."""
+    sup = Supervisor(
+        2, str(tmp_path / "wal"), docs_per_shard=8,
+        config=ClusterConfig(probe_timeout_s=0.5, **FAST),
+    ).start()
+    hung_pid = None
+    try:
+        room = "hang-room"
+        doc = Y.Doc(gc=False)
+        doc.client_id = 11
+        doc.get_text("text").insert(0, "before the hang")
+        assert sup.receive_update(room, Y.encode_state_as_update(doc))
+        sup.flush(room)
+
+        owner = sup.owner_of(room)
+        hung_pid = sup._shards[owner].pid
+        os.kill(hung_pid, signal.SIGSTOP)
+
+        report = _wait_outcome(sup, "recovered")
+        ev = report["events"][0]
+        assert ev["shard"] == owner
+        assert ev["outcome"] == "recovered"
+        # the replacement serves the room again, WAL replayed
+        deadline = time.time() + 30
+        text = None
+        while time.time() < deadline:
+            try:
+                text = sup.text(room)
+                break
+            except (RpcBusy, RpcError):
+                time.sleep(0.1)
+        assert text == "before the hang"
+        assert sup._shards[owner].pid != hung_pid
+    finally:
+        if hung_pid is not None:
+            try:
+                os.kill(hung_pid, signal.SIGKILL)
+            except OSError:
+                pass
+        sup.close()
+
+
+def test_spawn_ready_timeout_kills_silent_child(tmp_path):
+    """A child that starts but never prints its ready line must fail
+    the spawn at ``spawn_timeout_s`` — not block the caller forever
+    (during a restart the caller is the monitor thread, i.e. all
+    supervision) — and must not leak the process."""
+    import subprocess
+
+    sup = Supervisor(
+        1, str(tmp_path / "wal"),
+        config=ClusterConfig(spawn_timeout_s=0.5, **FAST),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="timed out"):
+        sup._read_ready(proc)
+    assert time.monotonic() - t0 < 10.0
+    assert proc.poll() is not None  # killed, not leaked
+
+    # and a child that dies before ready reports its exit code
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "raise SystemExit(3)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    with pytest.raises(RuntimeError, match="exited before ready"):
+        sup._read_ready(proc)
+
+
 def test_supervisor_facade_and_federated_metrics(tmp_path):
     """The FleetRouter-shaped facade over RPC: sv/diff/text round-trip,
     and the federated snapshot carries every shard's families plus the

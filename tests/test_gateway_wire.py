@@ -13,6 +13,7 @@ import json
 import os
 import socket
 import tempfile
+import threading
 import time
 
 import pytest
@@ -277,6 +278,65 @@ def test_step2_from_plain_reader_applies(gw):
         time.sleep(0.05)
     assert gw.cluster.text(room) == "via step2"
     c.close()
+
+
+def test_split_get_still_sniffs_websocket_dialect(gw):
+    """TCP may deliver the request head split — a first segment of just
+    ``G`` must still classify as the ws dialect, not fall through to a
+    raw length-prefixed frame parse that kills the connection."""
+    sock = socket.create_connection(("127.0.0.1", gw.port), timeout=20)
+    key = base64.b64encode(os.urandom(16)).decode("ascii")
+    request = (
+        "GET /split-room HTTP/1.1\r\nHost: t\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Version: 13\r\n\r\n"
+    ).encode("ascii")
+    sock.sendall(request[:1])  # just 'G'
+    time.sleep(0.3)  # let the sniffer peek the short head
+    sock.sendall(request[1:])
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = sock.recv(4096)
+        assert chunk, "gateway dropped the split-GET connection"
+        resp += chunk
+    assert b" 101 " in resp.split(b"\r\n")[0] + b" "
+    sock.close()
+
+
+def test_localcluster_fanout_runs_on_dispatch_thread(tmp_path):
+    """The deadlock-fix pin: LocalCluster must deliver ``on_update``
+    from its dedicated dispatch thread, never synchronously from inside
+    the fleet's flush — that path runs under the facade lock, and a
+    subscriber taking the gateway lock there would invert the
+    gateway's gw._lock → cluster-lock order."""
+    fleet = FleetRouter(
+        n_shards=1, docs_per_shard=8, backend="cpu",
+        wal_dir=str(tmp_path / "wal"),
+    )
+    cluster = LocalCluster(fleet)
+    try:
+        seen = []
+        done = threading.Event()
+
+        def on_update(guid, update):
+            seen.append(threading.current_thread().name)
+            # re-entering the facade from the callback must be legal
+            # (the gateway reads state vectors during fan-out handling)
+            cluster.state_vector_bytes(guid)
+            done.set()
+
+        cluster.on_update = on_update
+        doc = Y.Doc(gc=False)
+        doc.client_id = 7
+        doc.get_text("text").insert(0, "thread pin")
+        assert cluster.receive_update(
+            "pin-room", Y.encode_state_as_update(doc)
+        )
+        cluster.flush("pin-room")
+        assert done.wait(30), "fan-out never fired"
+        assert seen[0] == "ytpu-localcluster-evt"
+    finally:
+        cluster.close()
 
 
 def test_awareness_passthrough_and_query(gw):

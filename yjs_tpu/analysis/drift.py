@@ -296,6 +296,16 @@ def live_comparison(root) -> list:
     prov = TpuProvider(1)
     FleetRouter(1, 1)
     register_lint_metric()  # the lint counter is part of the contract
+    # the cluster families are lazily-registered process-global
+    # singletons (no Supervisor/Gateway is spun up here) — touch each
+    # holder so the live set includes them
+    from yjs_tpu.cluster.gateway import _GatewayMetricsSingleton
+    from yjs_tpu.cluster.rpc import rpc_metrics
+    from yjs_tpu.cluster.supervisor import _ClusterMetrics
+
+    _GatewayMetricsSingleton.get()
+    rpc_metrics()
+    _ClusterMetrics()
     live = set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

@@ -127,6 +127,7 @@ class GatewayConfig:
         "max_frame",
         "tick_s",
         "awareness",
+        "send_timeout_s",
     )
 
     def __init__(
@@ -136,6 +137,7 @@ class GatewayConfig:
         max_frame: int | None = None,
         tick_s: float | None = None,
         awareness: bool | None = None,
+        send_timeout_s: float | None = None,
     ):
         self.host = (
             host
@@ -161,4 +163,12 @@ class GatewayConfig:
             awareness
             if awareness is not None
             else _env_int("YTPU_GATEWAY_AWARENESS", 1) != 0
+        )
+        # bound on a blocking ws send to one client (SO_SNDTIMEO): a
+        # peer that stops reading is severed instead of stalling the
+        # fan-out thread forever.  0 disables the bound.
+        self.send_timeout_s = (
+            send_timeout_s
+            if send_timeout_s is not None
+            else _env_float("YTPU_GATEWAY_SEND_TIMEOUT_S", 15.0)
         )

@@ -40,7 +40,9 @@ from __future__ import annotations
 import base64
 import hashlib
 import socket
+import struct
 import threading
+import time
 from urllib.parse import unquote
 
 from ..lib0 import decoding, encoding
@@ -184,12 +186,45 @@ class LocalCluster:
         self._lock = threading.RLock()
         self.on_update = None
         self.on_epoch = None
+        # flush-emitted updates re-dispatch on a dedicated thread, the
+        # same shape as Supervisor._evt_loop: the fleet fires its
+        # on_update bridge synchronously inside flush() — i.e. while
+        # this facade's lock is held — so calling the gateway (which
+        # takes gw._lock) from here would invert the gateway's
+        # gw._lock → cluster-lock order and deadlock against the tick
+        # loop.  The queue keeps the facade lock a leaf for callbacks.
+        self._evt_q: list[tuple[str, bytes]] = []
+        self._evt_wake = threading.Condition()
+        self._evt_stop = False
+        self._evt_thread = threading.Thread(
+            target=self._evt_loop, name="ytpu-localcluster-evt", daemon=True
+        )
         fleet.on_update(self._fan)
+        self._evt_thread.start()
 
     def _fan(self, guid: str, update: bytes) -> None:
-        cb = self.on_update
-        if cb is not None:
-            cb(guid, update)
+        with self._evt_wake:
+            if self._evt_stop:
+                return
+            self._evt_q.append((guid, bytes(update)))
+            self._evt_wake.notify()
+
+    def _evt_loop(self) -> None:
+        while True:
+            with self._evt_wake:
+                while not self._evt_q and not self._evt_stop:
+                    self._evt_wake.wait()
+                if not self._evt_q and self._evt_stop:
+                    return
+                batch, self._evt_q[:] = list(self._evt_q), []
+            cb = self.on_update
+            if cb is None:
+                continue
+            for guid, update in batch:
+                try:
+                    cb(guid, update)
+                except Exception:
+                    pass  # a bad subscriber must not stall fan-out
 
     @property
     def epoch(self) -> int:
@@ -255,6 +290,14 @@ class LocalCluster:
             return self.fleet.recovery_report()
 
     def close(self) -> None:
+        with self._evt_wake:
+            self._evt_stop = True
+            self._evt_wake.notify_all()
+        if (
+            self._evt_thread.is_alive()
+            and self._evt_thread is not threading.current_thread()
+        ):
+            self._evt_thread.join(timeout=5.0)
         with self._lock:
             self.fleet.close()
 
@@ -430,6 +473,19 @@ class _GatewayConn:
         if not self._ws_handshake():
             gw._drop_conn(self)
             return
+        t = gw.config.send_timeout_s
+        if t > 0:
+            # send-side bound only (a plain settimeout would also make
+            # idle recv() loops time out): a client with a full TCP
+            # send buffer fails the send instead of blocking forever
+            try:
+                self.sock.setsockopt(
+                    socket.SOL_SOCKET,
+                    socket.SO_SNDTIMEO,
+                    struct.pack("ll", int(t), int((t % 1.0) * 1e6)),
+                )
+            except (OSError, struct.error):
+                pass
         gw._register(self)
         # y-websocket servers open with their step 1 (+ cached awareness)
         try:
@@ -476,9 +532,12 @@ class _GatewayConn:
         gw.metrics.frames.labels(dir="rx", kind=kind).inc()
         if outer == MESSAGE_SYNC:
             inner = bytes(data[dec.pos:])
+            # the facade serializes internally — holding gw._lock across
+            # a shard call would stall every other connection for the
+            # RPC's duration (and is never needed for lock ordering:
+            # gw._lock → cluster is the one legal order)
             try:
-                with gw._lock:
-                    reply = gw.cluster.handle_sync_message(self.room, inner)
+                reply = gw.cluster.handle_sync_message(self.room, inner)
             except (RpcBusy, RpcError):
                 # no ack concept on this dialect: count the drop; the
                 # client repairs via its reconnect resync
@@ -551,11 +610,27 @@ class _GatewayConn:
 
     # -- common --------------------------------------------------------------
 
+    def _sniff(self) -> bytes:
+        """Peek the first bytes without consuming them.  TCP may hand
+        the head over split (a ws client's ``GET`` can arrive as just
+        ``G``), so keep peeking until ≥3 bytes, EOF, or a grace
+        deadline — a single short peek would misclassify the dialect."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                head = self.sock.recv(4, socket.MSG_PEEK)
+            except OSError:
+                return b""
+            if not head or len(head) >= 3:
+                return head
+            if time.monotonic() >= deadline:
+                return head
+            time.sleep(0.005)
+
     def serve(self) -> None:
         """Sniff the dialect and run the connection (its own thread)."""
-        try:
-            head = self.sock.recv(4, socket.MSG_PEEK)
-        except OSError:
+        head = self._sniff()
+        if not head:
             self.gateway._drop_conn(self)
             return
         if head.startswith(b"GET"):
@@ -739,11 +814,15 @@ class Gateway:
         """A shard flushed a merged update for ``guid``: fan it to every
         connection in the room (both dialects).  Yjs integration is
         idempotent, so echoing the originator its own merged delta is
-        harmless and keeps the path branch-free."""
-        ws_frame = None
+        harmless and keeps the path branch-free.
+
+        Session sends only enqueue to the transport's writer thread, so
+        they stay under the lock; ws sends block in ``sendall``, so they
+        happen OUTSIDE ``gw._lock`` — one stalled client must never
+        wedge the tick loop, raw-frame delivery, or other rooms."""
+        ws_conns = []
         with self._lock:
-            conns = list(self._rooms.get(guid, ()))
-            for c in conns:
+            for c in list(self._rooms.get(guid, ())):
                 if c.session is not None:
                     if not c.session._closed:
                         c.session.send_update(update)
@@ -751,15 +830,22 @@ class Gateway:
                             dir="tx", kind="session_update"
                         ).inc()
                 elif c.dialect == "ws":
-                    if ws_frame is None:
-                        enc = Encoder()
-                        encoding.write_var_uint(enc, MESSAGE_SYNC)
-                        protocol.write_update(enc, update)
-                        ws_frame = enc.to_bytes()
-                    c.send_ws(ws_frame)
-                    self.metrics.frames.labels(
-                        dir="tx", kind="sync"
-                    ).inc()
+                    ws_conns.append(c)
+        if not ws_conns:
+            return
+        enc = Encoder()
+        encoding.write_var_uint(enc, MESSAGE_SYNC)
+        protocol.write_update(enc, update)
+        ws_frame = enc.to_bytes()
+        for c in ws_conns:
+            if c.send_ws(ws_frame):
+                self.metrics.frames.labels(dir="tx", kind="sync").inc()
+            else:
+                # send failed (dead peer or SO_SNDTIMEO expired on a
+                # stalled one): sever the connection so its rx loop
+                # exits instead of wedging future fan-outs
+                self._drop_conn(c)
+                c.close()
 
     def _on_epoch(self, epoch: int, shards) -> None:
         """Routing epoch bumped (restart/failover/migration): rehome

@@ -63,7 +63,7 @@ class _ShardProc:
 
     __slots__ = (
         "shard_id", "wal_dir", "proc", "port", "pid", "client",
-        "restarts", "state", "recovery",
+        "restarts", "state", "recovery", "probe_fails",
     )
 
     def __init__(self, shard_id: int, wal_dir: str):
@@ -76,6 +76,7 @@ class _ShardProc:
         self.restarts = 0
         self.state = "starting"  # starting|live|restarting|lost
         self.recovery = {}
+        self.probe_fails = 0  # consecutive unanswered heartbeat probes
 
     def row(self) -> dict:
         return {
@@ -209,22 +210,63 @@ class Supervisor:
             sp.client = client
             sp.recovery = ready.get("recovery") or {}
             sp.state = "live"
+            sp.probe_fails = 0
             live = sum(
                 1 for s in self._shards.values() if s.state == "live"
             )
         self.metrics.shards_live.set(live)
 
     def _read_ready(self, proc) -> dict:
-        deadline = time.monotonic() + self.config.spawn_timeout_s
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if not line:
+        """The ready line, under a real deadline, from a thread that
+        then owns the child's stdout for its whole lifetime.
+
+        A plain ``readline()`` would block past ``spawn_timeout_s`` on
+        a child that starts but never prints (hung import), wedging the
+        caller — which during a restart is the monitor thread, i.e. all
+        supervision.  And once ready, the pipe still needs a reader:
+        stdout chatter from the shard or its libraries would otherwise
+        fill the 64KB pipe buffer and block the shard process."""
+        slot: list = []
+        got = threading.Event()
+
+        def _pump(out=proc.stdout):
+            try:
+                for line in out:
+                    if not got.is_set() and line.startswith(READY_PREFIX):
+                        try:
+                            slot.append(
+                                json.loads(line[len(READY_PREFIX):])
+                            )
+                        except ValueError:
+                            pass
+                        got.set()
+                    # post-ready lines: drained and discarded
+            except (OSError, ValueError):
+                pass
+            finally:
+                got.set()  # EOF before ready: wake the waiter now
+
+        threading.Thread(
+            target=_pump,
+            name=f"ytpu-shard-stdout-{proc.pid}",
+            daemon=True,
+        ).start()
+        got.wait(self.config.spawn_timeout_s)
+        if slot:
+            return slot[0]
+        if got.is_set():
+            # EOF without a ready line: the child is on its way out —
+            # reap it so the error carries the real exit code
+            try:
+                rc = proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                rc = None
+            if rc is not None:
                 raise RuntimeError(
-                    "shard process exited before ready "
-                    f"(rc={proc.poll()})"
+                    f"shard process exited before ready (rc={rc})"
                 )
-            if line.startswith(READY_PREFIX):
-                return json.loads(line[len(READY_PREFIX):])
+        proc.kill()
+        proc.wait()
         raise RuntimeError("shard ready line timed out")
 
     def close(self) -> None:
@@ -471,6 +513,16 @@ class Supervisor:
 
     def _monitor_loop(self) -> None:
         next_snap = time.monotonic() + self.config.snapshot_s
+        # the hang lane: a shard whose process is alive and socket open
+        # but which stopped serving (e.g. deadlocked under its provider
+        # lock) is invisible to poll()/alive — only an unanswered
+        # heartbeat RPC convicts it.  Probes run at a coarser cadence
+        # than the poll loop; each one blocks this thread for at most
+        # probe_timeout_s.
+        probe_every = max(
+            self.config.heartbeat_s, self.config.probe_timeout_s / 2.0
+        )
+        next_probe: dict[int, float] = {}
         while not self._stop.wait(self.config.heartbeat_s):
             if self.config.snapshot_dir and time.monotonic() >= next_snap:
                 next_snap = time.monotonic() + self.config.snapshot_s
@@ -490,8 +542,36 @@ class Supervisor:
                 if not dead:
                     client = sp.client
                     dead = client is None or not client.alive
+                if (
+                    not dead
+                    and time.monotonic()
+                    >= next_probe.get(sp.shard_id, 0.0)
+                ):
+                    next_probe[sp.shard_id] = (
+                        time.monotonic() + probe_every
+                    )
+                    dead = not self._probe(sp)
                 if dead and not self._stop.is_set():
                     self._handle_death(sp)
+
+    def _probe(self, sp: _ShardProc) -> bool:
+        """One heartbeat RPC against a live-looking shard; False means
+        hung.  Two consecutive unanswered probes (timeout or connection
+        loss) convict — a remote *error* is still an answer, and one
+        slow response (checkpoint, first-flush compile) gets a second
+        chance before a restart is forced."""
+        client = sp.client
+        if client is None:
+            return False
+        try:
+            client.call("heartbeat", timeout=self.config.probe_timeout_s)
+        except RpcClosed:
+            sp.probe_fails += 1
+            return sp.probe_fails < 2
+        except RpcError:
+            pass
+        sp.probe_fails = 0
+        return True
 
     def _handle_death(self, sp: _ShardProc) -> None:
         """Restart through recover, or fail over past the budget."""
