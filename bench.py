@@ -606,12 +606,180 @@ def bench_planner(
         "fastpath_structs": plan.fastpath_structs,
         "sched_structs": n_sched,
     }
+    res.update(bench_planner_cold_unique())
+    res.update(bench_planner_prepend())
     try:
         with open("BENCH_planner.json", "w") as f:
             json.dump(res, f, indent=2)
     except OSError:
         pass  # artifact only; the inline detail block is authoritative
     return res
+
+
+def _seg_lane_env(mode: str | None):
+    """Set/restore YTPU_PLAN_SEGMENT + disable the plan cache for an A/B
+    lane; returns the previous values for the finally block."""
+    prev = (
+        os.environ.get("YTPU_PLAN_SEGMENT"),
+        os.environ.get("YTPU_PLAN_CACHE"),
+    )
+    if mode is None:
+        os.environ.pop("YTPU_PLAN_SEGMENT", None)
+    else:
+        os.environ["YTPU_PLAN_SEGMENT"] = mode
+    return prev
+
+
+def _seg_lane_restore(prev):
+    for key, val in zip(("YTPU_PLAN_SEGMENT", "YTPU_PLAN_CACHE"), prev):
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+
+def bench_planner_cold_unique(n_docs: int = 1024, n_ops: int = 1500) -> dict:
+    """Cold-unique-frontier lane (ISSUE 15): 1024 DISTINCT traces with
+    the plan cache disabled — the frontier-keyed cache cannot hit by
+    construction, so the device-authoritative cold planner is the only
+    accelerator.  Records ``cold_device_ms_per_doc`` (plan phase, cache
+    off) and ``fastpath_residue_fraction`` (residue share of segment-
+    partitioned structs), plus a cache-warm per-doc rate for the
+    acceptance ratio and the ``YTPU_PLAN_SEGMENT=off`` A/B byte-identity
+    verdict."""
+    import gc
+
+    from yjs_tpu.ops import BatchEngine
+    from yjs_tpu.ops import plan_cache
+
+    updates = load_distinct_traces(n_docs, n_ops)
+
+    def one_run(mode, cache_on, prewarm=False):
+        prev = _seg_lane_env(mode)
+        os.environ["YTPU_PLAN_CACHE"] = "1" if cache_on else "0"
+        try:
+            plan_cache.reset_cache()
+            if prewarm:
+                w = BatchEngine(n_docs)
+                for i, u in enumerate(updates):
+                    w.queue_update(i, u)
+                w.flush()
+                np.asarray(w._right[:, 0])
+                w = None
+                gc.collect()
+            gc.collect()
+            time.sleep(2)  # let prior lane's buffer deletes drain
+            eng = BatchEngine(n_docs)
+            for i, u in enumerate(updates):
+                eng.queue_update(i, u)
+            t0 = time.perf_counter()
+            eng.flush()
+            np.asarray(eng._right[:, 0])
+            dt = time.perf_counter() - t0
+            m = dict(eng.last_flush_metrics or {})
+            states = [eng.encode_state_as_update(i) for i in range(n_docs)]
+            del eng
+            gc.collect()
+            if not cache_on:
+                plan_cache.reset_cache()
+            return dt, m, states
+        finally:
+            _seg_lane_restore(prev)
+
+    one_run("device", cache_on=False)  # warmup/compile
+    dt_dev, m_dev, s_dev = one_run("device", cache_on=False)
+    _dt_off, m_off, s_off = one_run("off", cache_on=False)
+    dt_warm, m_warm, _ = one_run("device", cache_on=True, prewarm=True)
+    seg_f = m_dev.get("plan_segment_fast", 0)
+    seg_r = m_dev.get("plan_segment_residue", 0)
+    cold_ms = m_dev.get("t_plan_s", 0.0) / n_docs * 1e3
+    warm_ms = m_warm.get("t_plan_s", 0.0) / n_docs * 1e3
+    cold_e2e = dt_dev / n_docs * 1e3
+    warm_e2e = dt_warm / n_docs * 1e3
+    return {
+        "cold_unique_n_docs": n_docs,
+        "cold_unique_trace_ops": n_ops,
+        "cold_device_ms_per_doc": round(cold_ms, 3),
+        "cold_walk_ms_per_doc": round(
+            m_off.get("t_plan_s", 0.0) / n_docs * 1e3, 3
+        ),
+        "cold_e2e_ms_per_doc": round(cold_e2e, 3),
+        "warm_e2e_ms_per_doc": round(warm_e2e, 3),
+        "warm_cache_plan_ms_per_doc": round(warm_ms, 3),
+        # acceptance: cold distinct_engine_path within ~2x of its
+        # cache-warm per-doc rate (whole-flush rate, not plan-phase-only)
+        "cold_vs_warm_ratio": round(cold_e2e / max(1e-9, warm_e2e), 2),
+        "fastpath_residue_fraction": round(
+            seg_r / max(1, seg_f + seg_r), 4
+        ),
+        "plan_segment_fast": seg_f,
+        "plan_segment_residue": seg_r,
+        "off_lane_byte_identical": s_dev == s_off,
+    }
+
+
+def bench_planner_prepend(n_docs: int = 64, n_chars: int = 100000) -> dict:
+    """Prepend-fragmented planner lane (ISSUE 15 bugfix pin): each doc
+    is one maximally fragmented head-prepend update (one item/char).
+    The monotone chain must plan without re-sorting the whole anchor
+    column per flush — r5's `bench_fragmented` (default env: plan cache
+    ON, 64 identical docs) measured 37.281 ms/doc; the acceptance bar
+    is a >=3x drop under the SAME conditions, with harsher cache-off
+    lanes alongside and the ``off`` planner lane byte-identical."""
+    import gc
+
+    from yjs_tpu.ops import BatchEngine
+    from yjs_tpu.ops import plan_cache
+
+    update = load_prepend_fixture(n_chars)
+
+    def one_run(mode, cache_on=False):
+        prev = _seg_lane_env(mode)
+        os.environ["YTPU_PLAN_CACHE"] = "1" if cache_on else "0"
+        try:
+            plan_cache.reset_cache()
+            gc.collect()
+            time.sleep(2)  # let prior lane's buffer deletes drain
+            eng = BatchEngine(n_docs)
+            for i in range(n_docs):
+                eng.queue_update(i, update)
+            t0 = time.perf_counter()
+            eng.flush()
+            np.asarray(eng._right[:, 0])
+            dt = time.perf_counter() - t0
+            m = dict(eng.last_flush_metrics or {})
+            state = eng.encode_state_as_update(0)
+            del eng
+            gc.collect()
+            plan_cache.reset_cache()
+            return dt, m, state
+        finally:
+            _seg_lane_restore(prev)
+
+    _ = one_run("device")  # warmup/compile
+    dt_dev, m_dev, s_dev = one_run("device")
+    _dt_off, m_off, s_off = one_run("off")
+    _dt_r5, m_r5, _ = one_run("device", cache_on=True)  # r5-parity lane
+    dev_ms = m_dev.get("t_plan_s", 0.0) / n_docs * 1e3
+    off_ms = m_off.get("t_plan_s", 0.0) / n_docs * 1e3
+    r5p_ms = m_r5.get("t_plan_s", 0.0) / n_docs * 1e3
+    return {
+        "prepend_n_docs": n_docs,
+        "prepend_chars_per_doc": n_chars,
+        # r5-parity conditions (plan cache on, bench_fragmented shape):
+        # the acceptance comparison against BENCH_local_r5.json's
+        # planner_ms_per_doc = 37.281
+        "prepend_planner_ms_per_doc": round(r5p_ms, 3),
+        "prepend_r5_baseline_ms_per_doc": 37.281,
+        "prepend_speedup_vs_r5": round(37.281 / max(1e-9, r5p_ms), 2),
+        # harsher cache-off lanes: every doc plans cold
+        "prepend_cold_ms_per_doc": round(dev_ms, 3),
+        "prepend_cold_walk_ms_per_doc": round(off_ms, 3),
+        "prepend_cold_speedup_vs_walk": round(
+            off_ms / max(1e-9, dev_ms), 2
+        ),
+        "prepend_off_lane_byte_identical": s_dev == s_off,
+    }
 
 
 def bench_flush(

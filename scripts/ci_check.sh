@@ -58,10 +58,21 @@ echo "== overload harness smoke (marker: loadgen) =="
 python -m pytest tests/ -q -m 'loadgen and not slow' -p no:cacheprovider
 
 echo "== planner smoke (marker: planner) =="
-# the plan-cache + segment-planning suite (ISSUE 9) is the newest
+# the plan-cache + segment-planning suite (ISSUE 9/15) is the newest
 # subsystem: cache-aliasing and fast-path-divergence regressions
 # surface fast and isolated
 python -m pytest tests/ -q -m 'planner and not slow' -p no:cacheprovider
+
+echo "== planner oracle corpus under np and jax backends (ISSUE 15) =="
+# the device-authoritative cold planner defaults to the fused "device"
+# lane; rerun the seeded oracle corpus with each fallback backend pinned
+# so a kernels-only or numpy-only regression can't hide behind the
+# default — the corpus asserts device-planned ranks == sequential YATA
+# walk ranks struct-for-struct, byte-identical states included
+YTPU_PLAN_SEGMENT=np python -m pytest tests/test_segment_planner.py -q \
+    -m 'not slow' -p no:cacheprovider
+YTPU_PLAN_SEGMENT=jax python -m pytest tests/test_segment_planner.py -q \
+    -m 'not slow' -p no:cacheprovider
 
 echo "== failover smoke (marker: failover) =="
 # the replication + failure-detection suite (ISSUE 8) is the newest

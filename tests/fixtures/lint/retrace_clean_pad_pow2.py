@@ -1,0 +1,29 @@
+"""Known-clean: the segment-planner idiom (ISSUE 15) — per-call query
+counts are padded up to a pow2 bucket BEFORE the jitted kernel sees
+them, and the result is sliced back down, so unique-per-chunk sizes
+never mint new trace signatures."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket_pow2(n, minimum=64):
+    return max(minimum, 1 << max(0, int(n) - 1).bit_length())
+
+
+def _pad_pow2(arr, n_pad, fill):
+    out = np.full(n_pad, fill, arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+@jax.jit
+def lookup_kernel(flat_keys, query_keys):
+    return jnp.searchsorted(flat_keys, query_keys, side="right") - 1
+
+
+def clean_padded_lookup(flat_keys, queries):
+    nq = _bucket_pow2(len(queries))
+    q = _pad_pow2(queries, nq, -1)
+    ranks = lookup_kernel(jnp.asarray(flat_keys), jnp.asarray(q))
+    return np.asarray(ranks)[: len(queries)]

@@ -80,6 +80,7 @@ BAD = [
 CLEAN = [
     "donation_clean.py",
     "retrace_clean.py",
+    "retrace_clean_pad_pow2.py",
     "locks_clean.py",
     "seams_clean.py",
     "seams_clean_cluster.py",

@@ -159,6 +159,41 @@ def test_python_mirror_path_emits_schema(monkeypatch):
     assert keysets == {frozenset(FLUSH_METRICS_SCHEMA)}
 
 
+def _distinct_doc_engine(n_docs, monkeypatch, mode="device"):
+    """One engine whose docs each carry a DISTINCT trace (no cache
+    dedup), flushed once cold — the fan-out shape plan_threads must
+    report (ISSUE 15 satellite: it used to report 1 on batched paths)."""
+    monkeypatch.setenv("YTPU_PLAN_SEGMENT", mode)
+    monkeypatch.setenv("YTPU_PLAN_CACHE", "0")
+    eng = BatchEngine(n_docs)
+    for i in range(n_docs):
+        for u in make_trace("interleaved", seed=100 + i, n_ops=12):
+            eng.queue_update(i, u)
+    eng.flush()
+    return eng.last_flush_metrics
+
+
+def test_plan_threads_reports_py_chunk_fanout(monkeypatch):
+    """Python path, device mode: the whole-chunk segment planner
+    co-plans every cold doc in one call — plan_threads reports that
+    fan-out, not 1."""
+    monkeypatch.setenv("YTPU_NO_NATIVE_PLAN", "1")
+    m = _distinct_doc_engine(4, monkeypatch)
+    assert m["plan_threads"] == 4
+    # the off lane plans per doc, serially
+    m_off = _distinct_doc_engine(4, monkeypatch, mode="off")
+    assert m_off["plan_threads"] == 1
+
+
+def test_plan_threads_reports_native_pool_width(monkeypatch):
+    if not native_plan_available():
+        pytest.skip("native plancore unavailable")
+    monkeypatch.setenv("YTPU_PLAN_THREADS", "3")
+    m = _distinct_doc_engine(4, monkeypatch)
+    # min(configured pool width, cold docs in the batch)
+    assert m["plan_threads"] == 3
+
+
 def test_steady_state_flush_donates(monkeypatch):
     """After the warm-up flush sized the tables, steady-state pipelined
     flushes reallocate nothing: donation hit rate 1.0."""

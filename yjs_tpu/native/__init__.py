@@ -227,6 +227,19 @@ def load():
         lib._has_clone_state = True
     except AttributeError:
         lib._has_clone_state = False
+    try:
+        # r15: emit_row chain-run anchor adoption — Python mirrors the
+        # YTPU_PLAN_SEGMENT knob into the lib and diffs the hit/lookup
+        # totals around each flush for the shared metrics schema
+        lib.ymx_set_plan_segment.restype = None
+        lib.ymx_set_plan_segment.argtypes = [ctypes.c_int]
+        lib.ymx_plan_segment_stats.restype = None
+        lib.ymx_plan_segment_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib._has_plan_segment = True
+    except AttributeError:
+        lib._has_plan_segment = False
     _lib = lib
     return _lib
 

@@ -69,6 +69,16 @@ constexpr int kErrUnsupported = -9;  // subdocument: demote doc to CPU core
 constexpr int kErrLegacy = -4;       // payload kind the scanner won't carry
 constexpr int kErrInternal = -8;
 
+// chain-run anchor adoption (the native twin of the segment planner's
+// fast set, ISSUE 15): when a scheduled ref's origin/rightOrigin sits
+// inside the row emit_row just produced — typing and prepend chains —
+// the anchor is adopted in O(1) instead of re-running the per-slot
+// fragment binary search.  Gated by YTPU_PLAN_SEGMENT=off through
+// ymx_set_plan_segment; hit/lookup totals feed the flush metrics.
+std::atomic<int> g_plan_segment{1};
+std::atomic<long long> g_seg_fast{0};
+std::atomic<long long> g_seg_lookup{0};
+
 struct ContentDesc {
   int64_t kind = kKindNone;
   int64_t buf = kNull;
@@ -1341,11 +1351,25 @@ struct Mirror {
     reserve_rows(sched.size());
     std::vector<int64_t> touched_map_segs;  // ascending on use (set below)
     if (tm_mark.size() < dh_mark.size()) tm_mark.resize(dh_mark.size(), 0);
+    // last row emit_row produced: rows emitted this pass are never split
+    // again within the pass (all cuts were applied in pre-split or
+    // inline), so containment against it is exact — chained refs adopt
+    // their anchor without the fragment binary search
+    const bool seg_on = g_plan_segment.load(std::memory_order_relaxed) != 0;
+    bool em_last_valid = false;
+    int64_t em_last_row = kNull, em_last_slot = kNull;
+    int64_t em_last_clock = 0, em_last_len = 0;
+    int64_t seg_fast_n = 0, seg_lookup_n = 0;
     auto emit_row = [&](const PendRef& ref) -> int {
       int64_t slot_ = slot(ref.client);
       if (ref.is_gc) {
-        add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
-                ContentDesc{}, 0, kNull);
+        int64_t row = add_row(slot_, ref.clock, ref.length, kNull, 0, kNull,
+                              0, true, ContentDesc{}, 0, kNull);
+        em_last_valid = true;
+        em_last_row = row;
+        em_last_slot = slot_;
+        em_last_clock = ref.clock;
+        em_last_len = ref.length;
         return 0;
       }
       int64_t left_row = kNull, right_row = kNull;
@@ -1353,16 +1377,32 @@ struct Mirror {
       bool degrade = false;
       if (ref.oc >= 0) {
         oslot = slot(ref.oc);
-        int64_t fi = frag_containing(oslot, ref.ok);
-        if (fi == kNull) return kErrInternal;
-        left_row = frag_row[oslot][(size_t)fi];
+        if (seg_on && em_last_valid && oslot == em_last_slot &&
+            ref.ok >= em_last_clock &&
+            ref.ok < em_last_clock + em_last_len) {
+          left_row = em_last_row;
+          seg_fast_n++;
+        } else {
+          int64_t fi = frag_containing(oslot, ref.ok);
+          if (fi == kNull) return kErrInternal;
+          left_row = frag_row[oslot][(size_t)fi];
+          if (seg_on) seg_lookup_n++;
+        }
         if (r_is_gc[left_row]) degrade = true;
       }
       if (ref.rc >= 0) {
         rslot = slot(ref.rc);
-        int64_t fi = frag_containing(rslot, ref.rk);
-        if (fi == kNull) return kErrInternal;
-        right_row = frag_row[rslot][(size_t)fi];
+        if (seg_on && em_last_valid && rslot == em_last_slot &&
+            ref.rk >= em_last_clock &&
+            ref.rk < em_last_clock + em_last_len) {
+          right_row = em_last_row;
+          seg_fast_n++;
+        } else {
+          int64_t fi = frag_containing(rslot, ref.rk);
+          if (fi == kNull) return kErrInternal;
+          right_row = frag_row[rslot][(size_t)fi];
+          if (seg_on) seg_lookup_n++;
+        }
         if (r_is_gc[right_row]) degrade = true;
       }
       int64_t parent_row = kNull;
@@ -1374,8 +1414,13 @@ struct Mirror {
         if (r_is_gc[parent_row] || r_ref[parent_row] != 7) degrade = true;
       }
       if (degrade) {
-        add_row(slot_, ref.clock, ref.length, kNull, 0, kNull, 0, true,
-                ContentDesc{}, 0, kNull);
+        int64_t row = add_row(slot_, ref.clock, ref.length, kNull, 0, kNull,
+                              0, true, ContentDesc{}, 0, kNull);
+        em_last_valid = true;
+        em_last_row = row;
+        em_last_slot = slot_;
+        em_last_clock = ref.clock;
+        em_last_len = ref.length;
         return 0;
       }
       int64_t sg;
@@ -1392,6 +1437,11 @@ struct Mirror {
       }
       int64_t row = add_row(slot_, ref.clock, ref.length, oslot, ref.ok,
                             rslot, ref.rk, false, ref.c, ref.ref, sg);
+      em_last_valid = true;
+      em_last_row = row;
+      em_last_slot = slot_;
+      em_last_clock = ref.clock;
+      em_last_len = ref.length;
       if (want_sched) plan.sched.push_back({{row, left_row, right_row, sg}});
       int64_t actual_left = list_insert(sg, row, left_row, right_row);
       if (seg_is_map(sg)) {
@@ -1471,6 +1521,11 @@ struct Mirror {
       int rc = emit_row(cur);
       if (rc != 0) return rc;
     }
+
+    if (seg_fast_n)
+      g_seg_fast.fetch_add(seg_fast_n, std::memory_order_relaxed);
+    if (seg_lookup_n)
+      g_seg_lookup.fetch_add(seg_lookup_n, std::memory_order_relaxed);
 
     lap("rows");
     // resolve delete ranges to row ids.  Ranges arrive grouped per
@@ -2557,6 +2612,18 @@ static int plan_pool_width() {
 }
 
 int ymx_plan_threads() { return plan_pool_width(); }
+
+// YTPU_PLAN_SEGMENT gate for the emit_row chain-run anchor adoption —
+// Python sets it from the env knob so the A/B `off` lane disables every
+// segment-planning shortcut, host and native alike
+void ymx_set_plan_segment(int on) { g_plan_segment.store(on != 0); }
+
+// cumulative [fast adoptions, fragment-search lookups] across every
+// prepare in the process; callers diff around a flush
+void ymx_plan_segment_stats(int64_t* out) {
+  out[0] = g_seg_fast.load(std::memory_order_relaxed);
+  out[1] = g_seg_lookup.load(std::memory_order_relaxed);
+}
 
 // batched twin of ymx_prepare: one call plans EVERY staged doc, writing a
 // 16-wide counts row per doc ([0..13] = ymx_prepare's layout, [14] =

@@ -85,11 +85,12 @@ FLUSH_METRICS_SCHEMA: dict = {
     "schedule_occupancy": 0.0,
     "n_pending_docs": 0,
     "pending_depth": 0,
-    # worker-pool width the native planner fans per-doc plans out to
-    # (1 = serial / Python planner; YTPU_PLAN_THREADS overrides).
-    # Reported as the widest pool any prepare batch in this flush
-    # actually used — min(pool width, docs in the batch), not the
-    # configured width.
+    # planner fan-out this flush actually used: the native planner's
+    # worker-pool width (min(pool width, docs in the batch);
+    # YTPU_PLAN_THREADS overrides the pool), or — on the Python path
+    # under YTPU_PLAN_SEGMENT=device — the number of cold docs
+    # co-planned by one whole-chunk segment-planner call (ISSUE 15).
+    # 1 = fully serial per-doc planning.
     "plan_threads": 1,
     # frontier-keyed plan cache (ISSUE 9): probes served from cache /
     # planned cold this flush, and structs placed by the segment-sorted
@@ -97,6 +98,11 @@ FLUSH_METRICS_SCHEMA: dict = {
     "plan_cache_hits": 0,
     "plan_cache_misses": 0,
     "plan_fastpath_structs": 0,
+    # device-authoritative segment planner (ISSUE 15): structs
+    # integrated straight from device-computed ranks (fast set) vs
+    # handed to the sequential YATA conflict fallback (residue)
+    "plan_segment_fast": 0,
+    "plan_segment_residue": 0,
     "t_compact_s": 0.0,
     "t_plan_s": 0.0,
     # t_plan_s split: snapshot-adoption time for cache hits vs cold

@@ -234,6 +234,17 @@ class KernelProfiler:
             self._host_children[op] = child
         child.observe(dt_s)
 
+    def host_op_stats(self) -> dict:
+        """op -> ``{"count", "total_s"}`` from the host batch-op
+        timers — the bench-phase view regression tests pin against
+        (e.g. the ISSUE 15 snapshot-reuse fix asserts ``plan_snapshot``
+        stays cold on monotone prepend runs)."""
+        out: dict = {}
+        for labels, series in self._batch_op_seconds.samples():
+            op = labels.get("op", "")
+            out[op] = {"count": series.count, "total_s": series.sum}
+        return out
+
     # -- inspection ----------------------------------------------------
 
     def snapshot(self) -> dict:

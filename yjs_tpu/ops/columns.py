@@ -421,6 +421,14 @@ class UnsupportedUpdate(Exception):
 # ---------------------------------------------------------------------------
 
 
+class _PlanCtx:
+    """Opaque phase A -> phase B carrier for the split cold plan
+    (ISSUE 15): the engine holds these while the segment planner
+    co-plans a whole chunk of cold docs in one batched kernel call."""
+
+    __slots__ = ("plan", "frag_sched", "applicable", "queries", "sd")
+
+
 @dataclass
 class StepPlan:
     """Per-doc inputs for one device integration step (un-padded)."""
@@ -1048,45 +1056,47 @@ class DocMirror:
                 if r != tail and r not in self._lww_deleted:
                     self._delete_row(r, plan)
 
-    def _segment_hints(self, frag_sched):
-        """Segment-sorted anchor pre-resolution (ISSUE 9): batch-resolve
-        every ref's origin/rightOrigin against a post-pre-split snapshot
-        of the fragment index (ONE composed-key searchsorted in
-        ``kernels.plan_anchor_lookup``) and detect intra-batch chains
-        (``kernels.plan_conflict_scan``), replacing up to three per-ref
-        binary searches with O(batch) array ops.
+    def _segment_queries(self, frag_sched):
+        """Anchor-query columns for the segment planner (ISSUE 15),
+        built AFTER the pre-split pass and BEFORE any row is added:
+        per-ref id/origin/rightOrigin columns plus the facts span
+        eligibility needs (GC flag, content kind, explicit parent).
+        Returns a :class:`~yjs_tpu.ops.segment_planner.SegmentQueries`
+        of fresh arrays, or None when planning is off or the batch is
+        too small to pay for kernel dispatch."""
+        from . import segment_planner as _sp  # deferred: imports kernels
 
-        Returns (hint_left, hint_right, chain_left, chain_right) python
-        lists, or None when disabled/too small.  Hints are verified
-        candidates — a NULL hint falls back to the sequential bisect walk
-        in the caller, so placement can never differ from the slow path.
-        MUST run after the pre-split pass (the snapshot has to include
-        this step's splits) and before any row is added (rows appended
-        mid-loop are resolved by fallback or chain, never the snapshot).
-        """
-        mode = os.environ.get("YTPU_PLAN_SEGMENT", "np")
         n = len(frag_sched)
-        if mode == "off" or n < 4:
+        if _sp.plan_segment_mode() == "off" or n < _sp.MIN_RUN:
             return None
-        from . import kernels as _kern  # deferred: kernels imports us
-
-        backend = "jax" if mode == "jax" else "np"
-        client = np.empty(n, np.int64)
-        clock = np.empty(n, np.int64)
-        length = np.empty(n, np.int64)
-        o_cl = np.full(n, -1, np.int64)
-        o_ck = np.zeros(n, np.int64)
-        o_slot = np.full(n, -1, np.int64)
-        r_cl = np.full(n, -1, np.int64)
-        r_ck = np.zeros(n, np.int64)
-        r_slot = np.full(n, -1, np.int64)
+        q = _sp.SegmentQueries()
+        q.n = n
+        q.client = client = np.empty(n, np.int64)
+        q.clock = clock = np.empty(n, np.int64)
+        q.length = length = np.empty(n, np.int64)
+        q.o_cl = o_cl = np.full(n, -1, np.int64)
+        q.o_ck = o_ck = np.zeros(n, np.int64)
+        q.o_slot = o_slot = np.full(n, -1, np.int64)
+        q.r_cl = r_cl = np.full(n, -1, np.int64)
+        q.r_ck = r_ck = np.zeros(n, np.int64)
+        q.r_slot = r_slot = np.full(n, -1, np.int64)
+        q.gc = gc = np.zeros(n, bool)
+        q.cref = cref = np.zeros(n, np.int64)
+        q.pid = pid = np.zeros(n, bool)
+        q.pname = pname = np.zeros(n, bool)
         slot_of = self.slot_of_client.get
         for j, ref in enumerate(frag_sched):
             client[j] = ref.client
             clock[j] = ref.clock
             length[j] = ref.length
             if ref.is_gc:
+                gc[j] = True
                 continue
+            cref[j] = ref.content_ref
+            if ref.parent_id is not None:
+                pid[j] = True
+            if ref.parent_name is not None:
+                pname[j] = True
             if ref.origin is not None:
                 c, k = ref.origin
                 o_cl[j] = c
@@ -1101,8 +1111,21 @@ class DocMirror:
                 s = slot_of(c)
                 if s is not None:
                     r_slot[j] = s
-        # snapshot of the fragment index, slot-major (per-slot runs are
-        # clock-sorted, so the composed key is globally sorted)
+        return q
+
+    def _segment_snapshot(self):
+        """Slot-major snapshot of the fragment index for batched anchor
+        lookup: ``(flat_slot, flat_clock, flat_row, row_len, n_slots)``.
+        Per-slot runs are clock-sorted, so the composed (slot, clock)
+        key is globally sorted.  This is the planner's expensive rebuild
+        — the segment planner only calls it when the chain masks leave
+        enough anchors unresolved (monotone prepend/typing runs reuse
+        the prior per-slot sorted segments instead, ISSUE 15)."""
+        import time as _time
+
+        from ..obs.prof import kernel_profiler
+
+        t0 = _time.perf_counter()
         sizes = [len(fc) for fc in self.frag_clock]
         total = sum(sizes)
         if total:
@@ -1119,39 +1142,11 @@ class DocMirror:
             flat_clock = np.empty(0, np.int64)
             flat_row = np.empty(0, np.int64)
             flat_slot = np.empty(0, np.int64)
-        # one lookup for both anchor kinds
-        q_slot = np.concatenate([o_slot, r_slot])
-        q_ck = np.concatenate([o_ck, r_ck])
-        cand = _kern.plan_anchor_lookup(
-            flat_slot, flat_clock, q_slot, q_ck, backend=backend
-        )
-        # verify slot match + containment against the live columns; a
-        # miss (new intra-batch target, degenerate key) yields NULL and
-        # the caller's bisect fallback resolves it
         row_len = np.asarray(self.row_len, np.int64)
-        safe = np.clip(cand, 0, max(0, total - 1))
-        if total:
-            c_row = flat_row[safe]
-            ok = (
-                (cand >= 0)
-                & (q_slot >= 0)
-                & (flat_slot[safe] == q_slot)
-                & (q_ck >= flat_clock[safe])
-                & (q_ck < flat_clock[safe] + row_len[c_row])
-            )
-            hint = np.where(ok, c_row, NULL)
-        else:
-            hint = np.full(2 * n, NULL, np.int64)
-        chain_l, chain_r, _runs = _kern.plan_conflict_scan(
-            client, clock, length, o_cl, o_ck, r_cl, r_ck,
-            backend=backend,
+        kernel_profiler().record_host_op(
+            "plan_snapshot", _time.perf_counter() - t0
         )
-        return (
-            hint[:n].tolist(),
-            hint[n:].tolist(),
-            chain_l.tolist(),
-            chain_r.tolist(),
-        )
+        return flat_slot, flat_clock, flat_row, row_len, len(sizes)
 
     # -- the flush pipeline -------------------------------------------------
 
@@ -1171,23 +1166,59 @@ class DocMirror:
         """Consume queued updates and produce the device step plan — the
         cold planning path; advances the plan frontier on success and
         poisons it on any failure (the mirror may be mid-step then, see
-        the inner docstring)."""
+        the inner docstring).  Equivalent to ``prepare_step_begin()``
+        followed by ``prepare_step_finish(token, "auto", …)`` — the
+        engine uses the split form to co-plan whole chunks of cold docs
+        in one segment-planner call (ISSUE 15)."""
+        token = self.prepare_step_begin()
+        return self.prepare_step_finish(token, "auto", want_levels)
+
+    def prepare_step_begin(self):
+        """Phase A of the cold plan: decode, causal scheduling, DS
+        clamping, the pre-split pass, and the segment-planner query
+        build.  Returns an opaque token for ``prepare_step_finish``;
+        ``token.queries`` (may be None) and the mirror's
+        ``_segment_snapshot`` are what :func:`segment_planner.plan_chunk`
+        consumes to co-plan many docs at once.  Poisons the plan
+        frontier on failure, exactly like ``prepare_step``."""
         sd = _pc.staged_digest(self._incoming)
         try:
-            plan = self._prepare_step_impl(want_levels)
+            ctx = self._prepare_phase_a()
         except BaseException:
             self.plan_frontier = _pc.poison_frontier()
             _pc.note_invalidation("plan-error")
             raise
-        self.plan_frontier = _pc.fold(self.plan_frontier, b"u", sd)
+        ctx.sd = sd
+        return ctx
+
+    def prepare_step_finish(self, token, seg_plan,
+                            want_levels: bool | None = None) -> StepPlan:
+        """Phase B of the cold plan: integration (bulk fast-set runs +
+        the sequential YATA fallback for the conflict residue), delete
+        resolution and plan finalization.  ``seg_plan`` is the
+        :class:`~yjs_tpu.ops.segment_planner.SegmentPlan` computed for
+        this doc (possibly within a chunk), ``None`` to run the pure
+        host walk, or ``"auto"`` to plan per-doc here.  Folds the plan
+        frontier on success and poisons it on failure — together with
+        ``prepare_step_begin`` this preserves ``prepare_step``'s cache
+        interop exactly (device-planned results fold the same digest)."""
+        try:
+            if isinstance(seg_plan, str):  # "auto": per-doc planning
+                from . import segment_planner as _sp
+
+                seg_plan = _sp.plan_doc(
+                    token.queries, snapshot=self._segment_snapshot
+                )
+            plan = self._prepare_phase_b(token, seg_plan, want_levels)
+        except BaseException:
+            self.plan_frontier = _pc.poison_frontier()
+            _pc.note_invalidation("plan-error")
+            raise
+        self.plan_frontier = _pc.fold(self.plan_frontier, b"u", token.sd)
         return plan
 
-    def _prepare_step_impl(self, want_levels: bool | None = None) -> StepPlan:
-        """Consume queued updates and produce the device step plan.
-
-        ``want_levels=False`` skips the level-parallel schedule (sched8 /
-        levels), which only the YATA device kernels consume — the default
-        bulk-apply path ships the final link values instead.
+    def _prepare_phase_a(self):
+        """Decode + schedule + pre-split (phase A of the cold plan).
 
         Raises :class:`UnsupportedUpdate` if an incoming ref is outside the
         device path's scope (nested types, subdocuments).  The mirror may
@@ -1336,30 +1367,62 @@ class DocMirror:
             plan.splits[pre_split_marker:], key=lambda p: (p[0], -p[1])
         )
 
+        # segment-planner queries (ISSUE 15) — built here because they
+        # MUST see the post-pre-split batch and the pre-integration
+        # fragment index (rows appended mid-loop are resolved by chain
+        # or bisect fallback, never the snapshot)
+        ctx = _PlanCtx()
+        ctx.plan = plan
+        ctx.frag_sched = frag_sched
+        ctx.applicable = applicable
+        ctx.queries = self._segment_queries(frag_sched)
+        ctx.sd = None
+        return ctx
+
+    def _prepare_phase_b(self, ctx, seg_plan,
+                         want_levels: bool | None = None) -> StepPlan:
+        """Integration + finalization (phase B of the cold plan).
+
+        ``seg_plan`` carries the device-computed answer: verified anchor
+        hints, chain masks, and the fast-set spans integrated in bulk
+        straight from the ranks; every struct it cannot place falls to
+        the sequential YATA walk below — the conflict residue."""
+        plan = ctx.plan
+        frag_sched = ctx.frag_sched
+        applicable = ctx.applicable
+        q = ctx.queries
         # -- row assignment + pointer resolution ---------------------------
-        # segment-sorted anchor hints (ISSUE 9): snapshot + chain masks;
-        # None disables (YTPU_PLAN_SEGMENT=off or a tiny batch)
-        hints = self._segment_hints(frag_sched)
-        if hints is not None:
-            hint_l, hint_r, chain_l, chain_r = hints
+        hint_l = hint_r = chain_l = chain_r = None
+        spans: dict[int, tuple[int, str]] = {}
+        if seg_plan is not None and q is not None:
+            chain_l, chain_r = seg_plan.chain_l, seg_plan.chain_r
+            hint_l, hint_r = seg_plan.hint_l, seg_plan.hint_r
+            spans = {s: (e, d) for s, e, d in seg_plan.spans}
         n_fastpath = 0
+        seg_fast = 0
+        seg_residue = 0
         prev_row = NULL  # row of frag_sched[j-1] (every branch adds one)
         touched_map_segs: set[int] = set()
-        for j, ref in enumerate(frag_sched):
+        n_sched = len(frag_sched)
+        j = 0
+        while j < n_sched:
+            ref = frag_sched[j]
             slot = self.slot(ref.client)
             if ref.is_gc:
                 prev_row = self._add_row(
                     slot, ref.clock, ref.length, None, None, True, None
                 )
+                j += 1
                 continue
+            run = spans.get(j)
             left_row = right_row = NULL
             degrade = False
             if ref.origin is not None:
-                if hints is not None:
+                if chain_l is not None:
                     if chain_l[j] and prev_row != NULL:
                         left_row = prev_row
-                    else:
-                        left_row = hint_l[j]
+                    elif hint_l is not None:
+                        left_row = int(hint_l[j])
                 if left_row == NULL:
                     oslot = self.slot(ref.origin[0])
                     fi = self._frag_containing(oslot, ref.origin[1])
@@ -1371,11 +1434,11 @@ class DocMirror:
                 if self.row_is_gc[left_row]:
                     degrade = True  # neighbour was GC'd (Item.js:380-395)
             if ref.right_origin is not None:
-                if hints is not None:
+                if chain_r is not None:
                     if chain_r[j] and prev_row != NULL:
                         right_row = prev_row
-                    else:
-                        right_row = hint_r[j]
+                    elif hint_r is not None:
+                        right_row = int(hint_r[j])
                 if right_row == NULL:
                     rslot = self.slot(ref.right_origin[0])
                     fi = self._frag_containing(rslot, ref.right_origin[1])
@@ -1402,6 +1465,7 @@ class DocMirror:
                 prev_row = self._add_row(
                     slot, ref.clock, ref.length, None, None, True, None
                 )
+                j += 1
                 continue
             # segment: explicit parent, else copied from the neighbour the
             # wire omitted it for (reference encoding.js canCopyParentInfo)
@@ -1442,6 +1506,9 @@ class DocMirror:
                 actual_left = left_row
                 n_fastpath += 1
             else:
+                # conflict residue: the sequential YATA walk, now the
+                # fallback for structs the segment planner cannot place
+                seg_residue += 1
                 actual_left = self._list_insert(
                     seg, row, left_row, right_row, plan
                 )
@@ -1459,6 +1526,23 @@ class DocMirror:
                 self._delete_row(row, plan)
             if ref.content_ref == 1:  # ContentDeleted
                 applicable.append((ref.client, ref.clock, ref.length))
+            # fast-set bulk integration (ISSUE 15): ref j starts a
+            # chained run the device ranks fully determine — verify the
+            # live-state preconditions once, then splice the interior
+            # without per-struct anchor resolution or walk.  Any miss
+            # falls back to the scalar loop (placement cannot differ).
+            if run is not None:
+                e, d = run
+                n_bulk, last_row = self._integrate_run(
+                    frag_sched, j, e, d, seg, row, hint_r, plan
+                )
+                if n_bulk:
+                    seg_fast += n_bulk
+                    n_fastpath += n_bulk
+                    prev_row = last_row
+                    j = e
+                    continue
+            j += 1
 
         # -- resolve delete ranges to row ids ------------------------------
         for client, clock, ln in applicable:
@@ -1483,6 +1567,10 @@ class DocMirror:
         plan.n_rows = self.n_rows
         plan.fastpath_structs = n_fastpath
         _pc.note_fastpath(n_fastpath)
+        plan.segment_fast = seg_fast
+        plan.segment_residue = seg_residue if seg_plan is not None else 0
+        if seg_plan is not None:
+            _pc.note_segment(seg_fast, plan.segment_residue)
         if want_levels is None or want_levels:
             plan.assign_levels(self._row_client)
         # finalize the bulk-apply deltas: FINAL values after all splices
@@ -1496,6 +1584,79 @@ class DocMirror:
         # on it to see delete-only changes
         self._gen += 1
         return plan
+
+    def _integrate_run(self, frag_sched, s, e, d, seg, row_s, hint_r,
+                       plan):
+        """Bulk-integrate the interior of a chained run straight from
+        the device ranks (the ISSUE 15 fast set).
+
+        ``frag_sched[s]`` was just integrated as ``row_s`` through the
+        normal sequential path; refs ``s+1 .. e-1`` chain purely in
+        direction ``d`` (statically verified by the planner: one
+        client, ascending clocks, no GC/delete/explicit-parent refs).
+        This verifies the LIVE-state preconditions the planner cannot
+        see — root non-map segment, the splice gap actually intact, the
+        shared right anchor not GC'd — and on any miss returns
+        ``(0, NULL)`` so the scalar loop integrates the span instead
+        (placement can never differ).  On success every interior struct
+        is placed by its rank: one fragment-index append + one splice
+        per row, no anchor resolution, no YATA walk."""
+        if self.seg_info[seg][2] != NULL or self.seg_is_map(seg):
+            return 0, NULL
+        nxt = self.list_next
+        right_const = NULL
+        if d == "l":
+            # the interior's one shared rightOrigin id, resolved once
+            nref = frag_sched[s + 1]
+            if nref.right_origin is not None:
+                if hint_r is not None:
+                    right_const = int(hint_r[s + 1])
+                if right_const == NULL:
+                    rslot = self.slot_of_client.get(nref.right_origin[0])
+                    if rslot is None:
+                        return 0, NULL
+                    fi = self._frag_containing(rslot, nref.right_origin[1])
+                    if fi is None:
+                        return 0, NULL
+                    right_const = self.frag_row[rslot][fi]
+                if self.row_is_gc[right_const]:
+                    return 0, NULL
+            # gap: row_s must sit immediately left of the shared anchor
+            if nxt[row_s] != right_const:
+                return 0, NULL
+        else:
+            # prepend run: each interior ref must become the new head
+            if self.head_of_seg[seg] != row_s:
+                return 0, NULL
+        slot = self.slot(frag_sched[s + 1].client)
+        add_row = self._add_row
+        rows = []
+        for k in range(s + 1, e):
+            ref = frag_sched[k]
+            rows.append(add_row(
+                slot, ref.clock, ref.length, ref.origin,
+                ref.right_origin, False, ref.content, ref.content_ref,
+                seg=seg,
+            ))
+        sched = plan.sched
+        prev = row_s
+        if d == "r":
+            for row in rows:
+                nxt[row] = prev
+                sched.append((row, NULL, prev, seg))
+                prev = row
+            self.head_of_seg[seg] = prev
+            plan._dl.update(rows)
+            plan._dh.add(seg)
+        else:
+            for row in rows:
+                nxt[prev] = row
+                sched.append((row, prev, right_const, seg))
+                prev = row
+            nxt[prev] = right_const
+            plan._dl.update(rows)
+            plan._dl.add(row_s)
+        return len(rows), prev
 
     def _note_deleted(self, slot: int, clock: int, ln: int) -> None:
         ranges = self.ds.setdefault(slot, [])

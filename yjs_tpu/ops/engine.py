@@ -37,10 +37,12 @@ from ..updates import InvalidUpdate, validate_update
 from ..updates import apply_update, apply_update_v2
 from .columns import NULL, DocMirror, UnsupportedUpdate
 from . import plan_cache
+from . import segment_planner
 from .native_mirror import (
     NativeMirror,
     native_plan_available,
     pack_apply_lanes,
+    plan_segment_stats,
     prepare_many,
 )
 from . import kernels
@@ -1193,6 +1195,7 @@ class BatchEngine:
         rolled_back = 0
         cache_hits = cache_misses = 0
         t_plan_cached = t_plan_cold = 0.0
+        plan_fanout = 1  # docs co-planned by one whole-chunk planner call
         emitting = bool(self._update_listeners)
         observing = self._event_listeners
         # kernel selection: "apply" (default, meshed or not) ships the
@@ -1227,6 +1230,18 @@ class BatchEngine:
                 plans = dict(work)  # presence for the empty-flush check
             else:
                 cache = plan_cache.get_cache()
+                seg_mode = segment_planner.plan_segment_mode()
+                # device mode co-plans every cold DocMirror's anchors in
+                # ONE batched kernel call (ISSUE 15): phase A runs per
+                # doc in the loop, the whole-chunk segment plan lands
+                # between, phase B finishes per doc below
+                chunk_cold: list = []  # (doc, mirror, cache key, phase-A token)
+                chunk_keys: set = set()
+                # intra-flush duplicates of a chunked doc's key wait for
+                # the leader's cache insert and replay it (the per-doc
+                # loop got this for free by inserting before the next
+                # lookup)
+                chunk_dup: list = []  # (doc, mirror, cache key)
                 for i, m in enumerate(self.mirrors):
                     if i in self.fallback:
                         continue
@@ -1253,6 +1268,27 @@ class BatchEngine:
                         cache_hits += 1
                         t_plan_cached += time.perf_counter() - t_d0
                         continue
+                    if seg_mode == "device" and type(m) is DocMirror:
+                        if key is not None and key in chunk_keys:
+                            chunk_dup.append((i, m, key))
+                            continue
+                        try:
+                            token = m.prepare_step_begin()
+                        except UnsupportedUpdate as e:
+                            self._demote(i, pre_svs.get(i), reason=str(e))
+                            demoted_now += 1
+                        except Exception as e:
+                            if self._strict:
+                                raise
+                            self._isolate_failure(i, e, pre_svs.get(i))
+                            demoted_now += 1
+                            rolled_back += 1
+                        else:
+                            chunk_cold.append((i, m, key, token))
+                            if key is not None:
+                                chunk_keys.add(key)
+                        t_plan_cold += time.perf_counter() - t_d0
+                        continue
                     try:
                         plans[i] = m.prepare_step(want_levels=want_levels)
                     except UnsupportedUpdate as e:
@@ -1275,6 +1311,72 @@ class BatchEngine:
                             else:
                                 cache.insert_py(key, m, plans[i])
                     t_plan_cold += time.perf_counter() - t_d0
+                if chunk_cold:
+                    t_d0 = time.perf_counter()
+                    try:
+                        seg_plans = segment_planner.plan_chunk(
+                            [
+                                (t.queries, m._segment_snapshot)
+                                for (_i, m, _k, t) in chunk_cold
+                            ],
+                            mode=seg_mode,
+                            mesh=self.mesh,
+                        )
+                    except Exception:
+                        # planner fault: fall back to per-doc planning
+                        # in finish (a doc-level fault there still
+                        # poisons/demotes only its own doc)
+                        seg_plans = ["auto"] * len(chunk_cold)
+                    co_planned = sum(
+                        1 for (_i, _m, _k, t) in chunk_cold
+                        if t.queries is not None
+                    )
+                    plan_fanout = max(plan_fanout, co_planned)
+                    for (i, m, key, token), sp in zip(chunk_cold, seg_plans):
+                        try:
+                            plans[i] = m.prepare_step_finish(
+                                token, sp, want_levels
+                            )
+                        except UnsupportedUpdate as e:
+                            self._demote(i, pre_svs.get(i), reason=str(e))
+                            demoted_now += 1
+                        except Exception as e:
+                            if self._strict:
+                                raise
+                            self._isolate_failure(i, e, pre_svs.get(i))
+                            demoted_now += 1
+                            rolled_back += 1
+                        else:
+                            if key is not None:
+                                cache_misses += 1
+                                cache.insert_py(key, m, plans[i])
+                    t_plan_cold += time.perf_counter() - t_d0
+                for i, m, key in chunk_dup:
+                    t_d0 = time.perf_counter()
+                    ent = cache.lookup(key) if cache is not None else None
+                    if ent is not None:
+                        m2, plans[i] = ent.clone()
+                        m.__dict__.clear()
+                        m.__dict__.update(m2.__dict__)
+                        cache_hits += 1
+                        t_plan_cached += time.perf_counter() - t_d0
+                        continue
+                    # leader demoted/failed before inserting: plan solo
+                    try:
+                        plans[i] = m.prepare_step(want_levels=want_levels)
+                    except UnsupportedUpdate as e:
+                        self._demote(i, pre_svs.get(i), reason=str(e))
+                        demoted_now += 1
+                    except Exception as e:
+                        if self._strict:
+                            raise
+                        self._isolate_failure(i, e, pre_svs.get(i))
+                        demoted_now += 1
+                        rolled_back += 1
+                    else:
+                        cache_misses += 1
+                        cache.insert_py(key, m, plans[i])
+                    t_plan_cold += time.perf_counter() - t_d0
         t_plan = time.perf_counter()
         # ONE schema (obs.FLUSH_METRICS_SCHEMA) for every exit: each path
         # overwrites only the fields it measures, so the key set cannot
@@ -1289,8 +1391,19 @@ class BatchEngine:
             t_plan_cold_s=t_plan_cold,
             plan_cache_hits=cache_hits,
             plan_cache_misses=cache_misses,
+            plan_threads=plan_fanout,
             plan_fastpath_structs=sum(
                 getattr(p, "fastpath_structs", 0) or 0
+                for p in plans.values()
+                if p is not None and not isinstance(p, NativeMirror)
+            ),
+            plan_segment_fast=sum(
+                getattr(p, "segment_fast", 0) or 0
+                for p in plans.values()
+                if p is not None and not isinstance(p, NativeMirror)
+            ),
+            plan_segment_residue=sum(
+                getattr(p, "segment_residue", 0) or 0
                 for p in plans.values()
                 if p is not None and not isinstance(p, NativeMirror)
             ),
@@ -1567,6 +1680,7 @@ class BatchEngine:
         ]
         b_loc = b // n_shards
         t_plan_acc = t_pack_acc = t_disp_acc = 0.0
+        seg_base = plan_segment_stats() if native else (0, 0)
         stats_tot = np.zeros(4, np.int64)
         lanes_padded_tot = 0
         work_ok: list = []  # native: (doc, mirror, counts); py: (doc, plan)
@@ -1703,6 +1817,11 @@ class BatchEngine:
                 # batch); 1 when every doc was served from the plan cache
                 "plan_threads": acc.plan_threads,
             })
+            seg_now = plan_segment_stats()
+            metrics["plan_segment_fast"] = max(0, seg_now[0] - seg_base[0])
+            metrics["plan_segment_residue"] = max(
+                0, seg_now[1] - seg_base[1]
+            )
         self._finish_flush(metrics)
 
     def _plan_chunk_native(self, chunk, pre_svs, acc):

@@ -601,6 +601,71 @@ def plan_conflict_scan(client, clock, length, o_client, o_clock,
     return left, right, run_id
 
 
+@profiled("plan_chunk_conflict_scan")
+@jax.jit
+def _chunk_conflict_scan_jax(doc_id, client, clock, length, o_client,
+                             o_clock, r_client, r_clock):
+    p_client, p_clock = client[:-1], clock[:-1]
+    p_end = p_clock + length[:-1]
+    same_doc = doc_id[1:] == doc_id[:-1]
+    left = (
+        same_doc
+        & (o_client[1:] == p_client)
+        & (o_client[1:] >= 0)
+        & (o_clock[1:] >= p_clock)
+        & (o_clock[1:] < p_end)
+    )
+    right = (
+        same_doc
+        & (r_client[1:] == p_client)
+        & (r_client[1:] >= 0)
+        & (r_clock[1:] >= p_clock)
+        & (r_clock[1:] < p_end)
+    )
+    pad = jnp.zeros(1, bool)
+    left = jnp.concatenate([pad, left])
+    right = jnp.concatenate([pad, right])
+    run_id = jnp.cumsum(~(left | right))
+    return left, right, run_id
+
+
+def plan_chunk_conflict_scan(doc_id, client, clock, length, o_client,
+                             o_clock, r_client, r_clock,
+                             backend: str = "np"):
+    """Doc-aware twin of :func:`plan_conflict_scan` for whole-chunk
+    planning (ISSUE 15): one scan over the doc-major concatenation of
+    every cold doc's flush batch.  ``doc_id`` breaks chains at doc
+    boundaries so a run can never span two documents — the rest of the
+    semantics match the per-doc kernel exactly."""
+    if backend == "jax":
+        l, r, g = _chunk_conflict_scan_jax(
+            doc_id, client, clock, length, o_client, o_clock,
+            r_client, r_clock
+        )
+        return np.asarray(l), np.asarray(r), np.asarray(g)
+    p_client, p_clock = client[:-1], clock[:-1]
+    p_end = p_clock + length[:-1]
+    same_doc = doc_id[1:] == doc_id[:-1]
+    left = np.zeros(len(client), bool)
+    right = np.zeros(len(client), bool)
+    left[1:] = (
+        same_doc
+        & (o_client[1:] == p_client)
+        & (o_client[1:] >= 0)
+        & (o_clock[1:] >= p_clock)
+        & (o_clock[1:] < p_end)
+    )
+    right[1:] = (
+        same_doc
+        & (r_client[1:] == p_client)
+        & (r_client[1:] >= 0)
+        & (r_clock[1:] >= p_clock)
+        & (r_clock[1:] < p_end)
+    )
+    run_id = np.cumsum(~(left | right))
+    return left, right, run_id
+
+
 # ---------------------------------------------------------------------------
 # export / sync kernels
 # ---------------------------------------------------------------------------

@@ -62,6 +62,33 @@ def native_plan_available() -> bool:
     return not os.environ.get("YTPU_NO_NATIVE_PLAN") and has_plancore()
 
 
+def _sync_plan_segment(lib) -> None:
+    """Mirror the YTPU_PLAN_SEGMENT knob into the core's emit_row gate
+    so the ``off`` A/B lane also disables the native chain-run anchor
+    adoption (ISSUE 15).  No-op on a stale binary-only .so."""
+    if lib is None or not getattr(lib, "_has_plan_segment", False):
+        return
+    from . import segment_planner
+
+    lib.ymx_set_plan_segment(
+        0 if segment_planner.plan_segment_mode() == "off" else 1
+    )
+
+
+def plan_segment_stats() -> tuple[int, int]:
+    """Cumulative (chain-run adoptions, fragment-search lookups) across
+    every native prepare in the process; callers diff around a flush.
+    (0, 0) when the core (or the symbol) is unavailable."""
+    if not native_plan_available():
+        return (0, 0)
+    lib = load()
+    if lib is None or not getattr(lib, "_has_plan_segment", False):
+        return (0, 0)
+    out = np.zeros(2, np.int64)
+    lib.ymx_plan_segment_stats(_p64(out))
+    return (int(out[0]), int(out[1]))
+
+
 def _p64(a: np.ndarray):
     return a.ctypes.data_as(_i64p)
 
@@ -323,6 +350,7 @@ class NativeMirror:
         if want_levels is None:
             want_levels = True
         lib, h = self._lib, self._h
+        _sync_plan_segment(lib)
         staged, ids, v2s = self._stage_bufs()
         counts = np.zeros(16, np.int64)
         rc = lib.ymx_prepare(
@@ -827,6 +855,7 @@ def prepare_many(work, want_levels: bool = False, want_sched: bool = True,
     t0 = time.perf_counter()
     n = len(work)
     lib = work[0][1]._lib
+    _sync_plan_segment(lib)
     handles = (ctypes.c_void_p * n)()
     buf_ofs = np.zeros(n + 1, np.int64)
     if getattr(lib, "_has_add_bufs_many", False):

@@ -138,6 +138,29 @@ _ENTRIES_G = _reg.gauge(
 _BYTES_G = _reg.gauge(
     "ytpu_plan_cache_bytes", "Approximate host bytes held by the plan cache"
 )
+# segment-planner families (ISSUE 15): the device-authoritative cold
+# planner partitions every flush batch into a fast set (integrated
+# straight from device-computed ranks) and a conflict residue (the only
+# structs handed to the sequential YATA walk, now a fallback)
+_SEG_FAST = _reg.counter(
+    "ytpu_plan_segment_fast_total",
+    "Structs integrated directly from segment-planner ranks (no "
+    "per-struct YATA walk)",
+)
+_SEG_RESIDUE = _reg.counter(
+    "ytpu_plan_segment_residue_total",
+    "Conflict-residue structs handed to the sequential YATA fallback",
+)
+_SEG_CHUNKS = _reg.counter(
+    "ytpu_plan_segment_chunks_total",
+    "Whole-chunk segment-planner invocations (cold docs co-planned in "
+    "one batched kernel call)",
+)
+_SEG_SNAP_SKIP = _reg.counter(
+    "ytpu_plan_segment_snapshot_reuse_total",
+    "Flushes that reused the per-slot sorted fragment segments as-is "
+    "(monotone chained runs) instead of rebuilding the flat snapshot",
+)
 
 
 def note_invalidation(reason: str) -> None:
@@ -161,6 +184,23 @@ def note_misses(n: int) -> None:
 def note_fastpath(n: int) -> None:
     if n:
         _FASTPATH.inc(n)
+
+
+def note_segment(fast: int, residue: int) -> None:
+    """Per-prepare fast-set / conflict-residue partition sizes from the
+    segment planner (ISSUE 15)."""
+    if fast:
+        _SEG_FAST.inc(fast)
+    if residue:
+        _SEG_RESIDUE.inc(residue)
+
+
+def note_segment_chunk() -> None:
+    _SEG_CHUNKS.inc()
+
+
+def note_snapshot_reuse() -> None:
+    _SEG_SNAP_SKIP.inc()
 
 
 def enabled() -> bool:
