@@ -1226,6 +1226,121 @@ def bench_obs_dist(n_ops: int = 200) -> dict:
     }
 
 
+def bench_obs_admin(n_ops: int = 200) -> dict:
+    """detail.obs_admin → BENCH_obs_admin.json: admin-plane overhead
+    (ISSUE 16).  The same per-doc ingest+flush hot path twice — no
+    admin server vs an embedded :class:`AdminServer` being scraped at
+    a realistic cadence (one endpoint every 250ms, rotating through
+    /metrics, /metrics.json, /statusz, /readyz — a 1s-interval
+    Prometheus scrape plus probes, still an order of magnitude hotter
+    than a production 15s scrape) from a background thread.  The
+    budget is <1% end-to-end: the plane is a daemon thread that only
+    wakes when a request arrives, and the registry reads it serves are
+    lock-free snapshots."""
+    import gc
+    import threading
+    import urllib.request
+
+    from yjs_tpu.obs.admin import AdminServer
+    from yjs_tpu.provider import TpuProvider
+
+    from yjs_tpu.core import Doc
+    from yjs_tpu.updates import encode_state_as_update
+
+    n_docs = int(os.environ.get("YTPU_BENCH_PROF_DOCS", "64"))
+    updates = load_distinct_traces(n_docs, n_ops)
+    # enough rounds that a run spans several scrape intervals — the
+    # one-shot ingest+flush shape finishes in single-digit ms, which
+    # would time a plane nobody ever scraped
+    rounds = int(os.environ.get("YTPU_BENCH_ADMIN_ROUNDS", "600"))
+    edits_per_round = 8
+    scrape_interval_s = 0.25
+    endpoints = ("/metrics", "/metrics.json", "/statusz", "/readyz")
+    scrapes = {"n": 0}
+
+    # fresh per-round edit payloads, pre-encoded so payload synthesis
+    # is outside both timed loops
+    round_edits = [
+        encode_state_as_update(
+            (d := Doc(gc=False),
+             d.get_text("text").insert(0, f"edit {k} "))[0]
+        )
+        for k in range(edits_per_round)
+    ]
+
+    def run(with_admin: bool, runs: int = 3) -> float:
+        times = []
+        for _ in range(runs):
+            gc.collect()
+            prov = TpuProvider(n_docs)
+            # seed every room once so the steady-state loop measures
+            # incremental merges, not first-touch allocation
+            for i, u in enumerate(updates):
+                prov.receive_update(f"room-{i}", u)
+            prov.flush()
+            admin = scraper = None
+            stop = threading.Event()
+            if with_admin:
+                admin = AdminServer(prov, role="provider").start()
+
+                def scrape_loop():
+                    k = 0
+                    while not stop.wait(scrape_interval_s):
+                        try:
+                            req = urllib.request.urlopen(
+                                admin.url + endpoints[k % len(endpoints)],
+                                timeout=5,
+                            )
+                            with req as r:
+                                r.read()
+                            scrapes["n"] += 1
+                        except OSError:
+                            pass  # teardown race; the timing loop owns exit
+                        k += 1
+
+                scraper = threading.Thread(target=scrape_loop, daemon=True)
+                scraper.start()
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                for k, u in enumerate(round_edits):
+                    prov.receive_update(
+                        f"room-{(r * edits_per_round + k) % n_docs}", u
+                    )
+                prov.flush()
+            np.asarray(prov.engine._right[:, 0])
+            times.append(time.perf_counter() - t0)
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5)
+            if admin is not None:
+                admin.close()
+            prov.close()
+        times.sort()
+        return times[len(times) // 2]
+
+    t_off = run(False)  # also warms the compile cache
+    t_on = run(True)
+    block = {
+        "n_docs": n_docs,
+        "trace_ops": n_ops,
+        "rounds": rounds,
+        "edits_per_round": edits_per_round,
+        "scrape_interval_s": scrape_interval_s,
+        "scrapes_served": scrapes["n"],
+        "admin_on_s": round(t_on, 4),
+        "admin_off_s": round(t_off, 4),
+        "overhead_pct": (
+            round(100 * (t_on - t_off) / t_off, 1) if t_off else 0
+        ),
+    }
+    try:
+        with open("BENCH_obs_admin.json", "w") as f:
+            json.dump(block, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return block
+
+
 def bench_network(n_ops: int = 200) -> dict:
     """Session-layer cost (ISSUE 5): the same cross-provider fan-out
     through per-room :class:`SyncSession` pairs over an in-memory pipe,
@@ -2149,6 +2264,8 @@ def main():
             json.dump(obs_dist, f, indent=2)
     except OSError:
         pass  # artifact only; the inline detail block is authoritative
+    time.sleep(3)
+    obs_admin = bench_obs_admin()
     sweep = (
         sweep_distinct(n_ops)
         if os.environ.get("YTPU_BENCH_SWEEP")
@@ -2203,6 +2320,7 @@ def main():
             "obs": obs_summary,
             "obs_prof": obs_prof,
             "obs_dist": obs_dist,
+            "obs_admin": obs_admin,
             "resilience": resilience,
             "durability": durability,
             "network": network,

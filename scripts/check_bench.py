@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""check_bench: the bench-regression gate (ISSUE 16).
+
+Re-runs the headline bench blocks in a scratch directory, then diffs
+their fresh numbers against the committed ``BENCH_*.json`` baselines
+with a per-metric tolerance band — exit 1 on any regression, so a perf
+cliff fails CI the same way a broken test does.
+
+Guarded metrics (direction-aware: a *better* number never fails):
+
+    BENCH_planner.json   cold_vs_warm_ratio      lower is better
+    BENCH_flush.json     overlap_fraction        higher is better
+    BENCH_cluster.json   process.converge_ms_p50 lower is better
+    BENCH_overload.json  shed_fraction           higher is better
+
+Modes:
+
+    python scripts/check_bench.py
+        Run the four bench blocks fresh (minutes; spawns the process
+        cluster) and compare.  The opt-in ``YTPU_CI_BENCH=1`` stage of
+        ``scripts/ci_check.sh``.
+
+    python scripts/check_bench.py --fresh-dir DIR
+        Skip the benchmarks and compare DIR's ``BENCH_*.json`` files
+        against the baselines — for unit tests of the comparison
+        logic, or for gating numbers produced on another machine.
+
+    python scripts/check_bench.py --list
+        Print the guarded metrics, baselines, and bands; exit 0.
+
+``--baseline-dir`` points somewhere other than the repo root;
+``--tolerance NAME=FLOAT`` (repeatable) overrides one band, e.g.
+``--tolerance planner.cold_vs_warm_ratio=0.5``.
+
+Tolerances are wide on purpose: CI containers are noisy neighbors and
+this gate exists to catch cliffs (a 2x planner regression, an overlap
+collapse), not 5% jitter.  Committed baselines only move when a PR
+deliberately reruns ``python bench.py`` and commits the artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# (name, artifact file, key path, direction, relative tolerance).
+# direction "lower": fresh may not exceed baseline*(1+tol);
+# direction "higher": fresh may not fall below baseline*(1-tol).
+METRICS = (
+    ("planner.cold_vs_warm_ratio", "BENCH_planner.json",
+     ("cold_vs_warm_ratio",), "lower", 0.40),
+    ("flush.overlap_fraction", "BENCH_flush.json",
+     ("overlap_fraction",), "higher", 0.20),
+    ("cluster.converge_ms_p50", "BENCH_cluster.json",
+     ("process", "converge_ms_p50"), "lower", 1.00),
+    ("overload.shed_fraction", "BENCH_overload.json",
+     ("shed_fraction",), "higher", 0.10),
+)
+
+
+def _dig(d: dict, path: tuple) -> float | None:
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    try:
+        return float(d)
+    except (TypeError, ValueError):
+        return None
+
+
+def _load(path: Path) -> dict:
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return d if isinstance(d, dict) else {}
+
+
+def compare(
+    fresh_dir: Path, baseline_dir: Path, tolerances: dict[str, float]
+) -> list[dict]:
+    """One verdict dict per guarded metric.  A missing fresh artifact
+    or key is itself a failure (the bench block silently dying must
+    not read as "no regression"); a missing *baseline* is skipped with
+    a note, so the gate can precede the first committed artifact."""
+    verdicts = []
+    for name, fname, path, direction, tol in METRICS:
+        tol = tolerances.get(name, tol)
+        base = _dig(_load(baseline_dir / fname), path)
+        fresh = _dig(_load(fresh_dir / fname), path)
+        v = {
+            "metric": name, "file": fname, "direction": direction,
+            "baseline": base, "fresh": fresh, "tolerance": tol,
+            "status": "ok", "bound": None,
+        }
+        if base is None:
+            v["status"] = "no-baseline"
+        elif fresh is None:
+            v["status"] = "missing-fresh"
+        elif direction == "lower":
+            v["bound"] = base * (1.0 + tol)
+            if fresh > v["bound"]:
+                v["status"] = "regression"
+        else:
+            v["bound"] = base * (1.0 - tol)
+            if fresh < v["bound"]:
+                v["status"] = "regression"
+        verdicts.append(v)
+    return verdicts
+
+
+def run_benchmarks(out_dir: Path) -> None:
+    """Run the guarded bench blocks with ``out_dir`` as the artifact
+    cwd (bench.py writes its BENCH_*.json relative to the cwd)."""
+    import bench
+
+    cwd = os.getcwd()
+    os.chdir(out_dir)
+    try:
+        bench.bench_planner()
+        bench.bench_flush()
+        bench.bench_overload()
+        bench.bench_cluster()
+    finally:
+        os.chdir(cwd)
+
+
+def render(verdicts: list[dict]) -> str:
+    lines = []
+    for v in verdicts:
+        arrow = "<=" if v["direction"] == "lower" else ">="
+        bound = "-" if v["bound"] is None else f"{v['bound']:.4g}"
+        lines.append(
+            f"  {v['status']:>13}  {v['metric']:<28} "
+            f"fresh={v['fresh'] if v['fresh'] is not None else '-':>8} "
+            f"{arrow} bound={bound:>8} "
+            f"(baseline={v['baseline']}, tol={v['tolerance']:.0%})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check_bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--fresh-dir", default=None, metavar="DIR",
+                    help="compare DIR's BENCH_*.json instead of "
+                         "re-running the bench blocks")
+    ap.add_argument("--baseline-dir", default=None, metavar="DIR",
+                    help="committed baselines (default: repo root)")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="NAME=FLOAT",
+                    help="override one metric's band, e.g. "
+                         "planner.cold_vs_warm_ratio=0.5")
+    ap.add_argument("--list", action="store_true",
+                    help="print the guarded metrics and exit")
+    args = ap.parse_args(argv)
+
+    baseline_dir = Path(
+        args.baseline_dir
+        if args.baseline_dir is not None
+        else Path(__file__).resolve().parent.parent
+    )
+    tolerances: dict[str, float] = {}
+    known = {m[0] for m in METRICS}
+    for spec in args.tolerance:
+        name, _, val = spec.partition("=")
+        if name not in known or not val:
+            ap.error(f"unknown --tolerance {spec!r} (metrics: "
+                     f"{', '.join(sorted(known))})")
+        tolerances[name] = float(val)
+
+    if args.list:
+        for name, fname, path, direction, tol in METRICS:
+            base = _dig(_load(baseline_dir / fname), path)
+            print(f"  {name:<28} {fname:<22} {direction:<7} "
+                  f"tol={tolerances.get(name, tol):.0%} baseline={base}")
+        return 0
+
+    if args.fresh_dir is not None:
+        verdicts = compare(Path(args.fresh_dir), baseline_dir, tolerances)
+    else:
+        with tempfile.TemporaryDirectory(prefix="ytpu-bench-") as td:
+            print("check_bench: running bench blocks (this takes a "
+                  "few minutes)...", flush=True)
+            run_benchmarks(Path(td))
+            verdicts = compare(Path(td), baseline_dir, tolerances)
+
+    print("check_bench verdicts:")
+    print(render(verdicts))
+    bad = [v for v in verdicts
+           if v["status"] in ("regression", "missing-fresh")]
+    if bad:
+        print(f"check_bench: FAILED ({len(bad)} regression(s))",
+              file=sys.stderr)
+        return 1
+    print("check_bench: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,11 @@ def registered_names() -> set[str]:
     _GatewayMetricsSingleton.get()
     rpc_metrics()
     _ClusterMetrics()
+    from yjs_tpu.obs.admin import admin_metrics
+    from yjs_tpu.obs.federate import fed_metrics
+
+    admin_metrics()
+    fed_metrics()
     return set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

@@ -22,6 +22,19 @@ echo "== trace validity (check_trace selftest) =="
 # sampled chain completes origin -> visible (ISSUE 11)
 python scripts/check_trace.py --selftest
 
+if [[ "${YTPU_CI_BENCH:-0}" == "1" ]]; then
+    echo "== bench-regression gate (YTPU_CI_BENCH=1) =="
+    # opt-in: re-runs the headline bench blocks (minutes) and diffs
+    # against the committed BENCH_*.json baselines (ISSUE 16)
+    python scripts/check_bench.py
+fi
+
+echo "== admin plane smoke (marker: admin) =="
+# the per-process introspection plane (ISSUE 16): endpoint unit tests,
+# readiness/fencing semantics, scrape-race hardening, and the
+# concurrent-scrape hammer
+python -m pytest tests/ -q -m 'admin and not slow' -p no:cacheprovider
+
 echo "== cluster smoke (marker: cluster) =="
 # the process-native cluster suite (ISSUE 14) is the newest subsystem:
 # real OS-process shards behind the y-websocket gateway — kill -9

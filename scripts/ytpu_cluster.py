@@ -38,7 +38,10 @@ over the config file.
 
 ``--smoke`` connects one raw-session client through the gateway, makes
 an edit, waits for the acked round-trip, verifies the text server-side,
-and exits 0/1 — the one-shot health probe `scripts/ci_check.sh` runs.
+then curls every spawned process's admin plane (``/healthz``,
+``/statusz``, and a well-formed ``/metrics`` exposition on the
+supervisor, each shard child, and the gateway — ISSUE 16), and exits
+0/1 — the one-shot health probe `scripts/ci_check.sh` runs.
 """
 
 from __future__ import annotations
@@ -79,9 +82,75 @@ def parse_compose(cfg: dict) -> dict:
     return out
 
 
+import re
+
+# a Prometheus exposition sample line: name{labels} value [timestamp]
+_EXPO_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?"
+    r" [-+]?([0-9.eE+-]+|NaN|Inf)( [0-9]+)?$"
+)
+
+
+def _check_admin(name: str, base: str) -> list[str]:
+    """Curl one process's admin plane: /healthz, /statusz, and a
+    well-formed /metrics exposition.  Returns failure strings."""
+    import urllib.request
+
+    fails = []
+    for ep in ("/healthz", "/statusz", "/metrics"):
+        try:
+            with urllib.request.urlopen(base + ep, timeout=10) as r:
+                body = r.read().decode("utf-8", "replace")
+                if r.status != 200:
+                    fails.append(f"{name}{ep}: HTTP {r.status}")
+                    continue
+        except OSError as e:
+            fails.append(f"{name}{ep}: {e}")
+            continue
+        if ep == "/statusz":
+            try:
+                json.loads(body)
+            except ValueError:
+                fails.append(f"{name}{ep}: malformed JSON")
+        elif ep == "/metrics":
+            bad = [
+                ln for ln in body.splitlines()
+                if ln and not ln.startswith("#")
+                and not _EXPO_LINE.match(ln)
+            ]
+            if bad:
+                fails.append(
+                    f"{name}{ep}: malformed exposition: {bad[0]!r}"
+                )
+            if "ytpu_" not in body:
+                fails.append(f"{name}{ep}: no ytpu_ families")
+    return fails
+
+
+def _smoke_admin(gw, sup) -> list[str]:
+    """Hit every spawned process's admin endpoints (ISSUE 16): the
+    supervisor, each shard child, and the gateway."""
+    fails = []
+    urls = dict(sup.admin_urls())
+    if "supervisor" not in urls:
+        fails.append("supervisor: admin plane not serving")
+    want_shards = {f"shard-{r['shard']:03d}"
+                   for r in sup.recovery_report()["shards"]}
+    missing = want_shards - set(urls)
+    fails.extend(f"{m}: admin plane not serving" for m in sorted(missing))
+    if gw.admin is not None and gw.admin.port:
+        urls["gateway"] = gw.admin.url
+    else:
+        fails.append("gateway: admin plane not serving")
+    for name, base in sorted(urls.items()):
+        fails.extend(_check_admin(name, base))
+    return fails
+
+
 def _smoke(gw, sup) -> int:
     """One edit through the gateway's session dialect, verified
-    server-side — exits nonzero unless the acked round-trip lands."""
+    server-side, plus an admin-plane probe of every process — exits
+    nonzero unless both land."""
     import socket as socketlib
 
     sys.path.insert(
@@ -120,8 +189,13 @@ def _smoke(gw, sup) -> int:
             time.sleep(0.5)  # let the ack drain before judging
             with conn.lock:
                 snap = conn.session.snapshot()
+        admin_fails = _smoke_admin(gw, sup)
+        if admin_fails:
+            for f in admin_fails:
+                print(f"smoke: admin FAILED {f}", file=sys.stderr)
+            return 1
         print(
-            "smoke: OK room=%r text=%r outbox=%s"
+            "smoke: OK room=%r text=%r outbox=%s admin=ok"
             % (room, text, snap.get("outbox_depth"))
         )
         return 0

@@ -14,6 +14,10 @@ Modes:
         counters sum, gauges keep per-shard series plus an aggregate,
         histograms merge) and render the fleet view.
 
+    python scripts/ytpu_stats.py --url http://127.0.0.1:9464 [--url ...]
+        Scrape a live process's admin-plane ``/metrics.json`` (ISSUE
+        16) and render it; several ``--url`` flags federate first.
+
     python scripts/ytpu_stats.py --demo [--prom|--json]
         Exercise a tiny in-process provider (a few rooms, a sync
         handshake, one undo, a WAL append, one dead letter) and dump its
@@ -55,6 +59,7 @@ GROUPS = (
     ("tiering", ("ytpu_tier_",)),
     ("replication", ("ytpu_repl_", "ytpu_failover_")),
     ("admission", ("ytpu_adm_",)),
+    ("admin plane", ("ytpu_admin_",)),
     ("tracing", ("ytpu_trace_",)),
     ("blackbox", ("ytpu_blackbox_",)),
     ("federation", ("ytpu_fed_",)),
@@ -219,6 +224,14 @@ def main(argv=None) -> int:
                          "before rendering")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny provider workload instead of reading a file")
+    ap.add_argument("--url", action="append", default=[],
+                    metavar="URL",
+                    help="scrape a live admin endpoint's /metrics.json "
+                         "instead of reading a file (repeatable; "
+                         "several URLs federate)")
+    ap.add_argument("--scrape-timeout", type=float, default=2.0,
+                    help="per-endpoint HTTP deadline for --url "
+                         "(default 2s)")
     ap.add_argument("--prom", action="store_true",
                     help="with --demo: print Prometheus text instead")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -243,8 +256,29 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(render_snapshot(prov.metrics_snapshot()))
         return 0
+    if args.url:
+        if args.snapshot:
+            ap.error("--url and snapshot files are mutually exclusive")
+        from yjs_tpu.obs.federate import (
+            federate_snapshots,
+            scrape_endpoints,
+        )
+
+        def render_url():
+            sources = scrape_endpoints(
+                args.url, timeout_s=args.scrape_timeout
+            )
+            if len(sources) == 1:
+                return render_snapshot(sources[0]["snapshot"] or {})
+            return render_snapshot(federate_snapshots(sources))
+
+        if args.watch is not None:
+            _watch(render_url, args.watch)
+            return 0
+        sys.stdout.write(render_url())
+        return 0
     if not args.snapshot:
-        ap.error("either a snapshot file or --demo is required")
+        ap.error("either a snapshot file, --url, or --demo is required")
 
     if args.merge:
         from yjs_tpu.obs.federate import (

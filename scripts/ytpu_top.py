@@ -22,6 +22,12 @@ Sources (one row per provider):
         leading ``FLEET`` aggregate row (counters summed, histograms
         merged) above the per-shard rows.
 
+    python scripts/ytpu_top.py --url http://127.0.0.1:9464 [--url ...]
+        Live scrape mode (ISSUE 16): poll each process's admin-plane
+        ``/metrics.json`` over HTTP.  Several ``--url`` flags federate
+        under a leading ``FLEET`` row; a dead endpoint renders as a
+        stale blank row.
+
     python scripts/ytpu_top.py --demo
         Run two in-process providers exchanging sync traffic, one frame
         of fresh edits per poll — the zero-to-dashboard smoke test.
@@ -62,6 +68,7 @@ COLUMNS = (
     ("wal rec", 8),
     ("occup", 6),
     ("ovlp", 6),
+    ("residue", 8),
     ("plnhit", 7),
     ("hot", 5),
     ("warm", 5),
@@ -167,6 +174,16 @@ def collect_row(
                              "phase=pack"))
             and (_ov := _hist(snap, "ytpu_flush_pack_overlap_seconds"))
             and _pk["sum"] > 0
+            else "-"
+        ),
+        # planner residue fraction (ISSUE 16): share of planned structs
+        # handed to the sequential YATA conflict fallback on the last
+        # flush with planner work ("-" until the planner has run)
+        "residue": (
+            f"{_re:.2f}"
+            if (_re := snap.get("gauges", {})
+                .get("ytpu_plan_segment_residue_fraction", {})
+                .get("")) is not None
             else "-"
         ),
         # plan-cache hit rate (process-global counters; "-" before the
@@ -426,6 +443,34 @@ class ClusterDirSource:
         return out
 
 
+class UrlSource:
+    """Admin-plane scrape mode (``--url``, ISSUE 16): every poll GETs
+    each endpoint's ``/metrics.json`` via
+    :func:`~yjs_tpu.obs.federate.scrape_endpoints`.  One URL renders a
+    single provider row; several federate under a leading ``FLEET``
+    row, with dead endpoints as stale blank rows (never a crash)."""
+
+    def __init__(self, urls: list[str], timeout_s: float = 2.0):
+        self.urls = list(urls)
+        self.timeout_s = timeout_s
+
+    def poll(self) -> list[tuple[str, dict]]:
+        from yjs_tpu.obs.federate import (
+            federate_snapshots,
+            scrape_endpoints,
+        )
+
+        sources = scrape_endpoints(self.urls, timeout_s=self.timeout_s)
+        out = []
+        if len(sources) > 1:
+            out.append(("FLEET", federate_snapshots(sources)))
+        for src in sources:
+            out.append(
+                (str(src.get("label", "?")), src.get("snapshot") or {})
+            )
+        return out
+
+
 class DemoSource:
     """Two in-process providers joined by per-room peer sessions over
     an in-memory pipe; every poll applies one fresh edit and pumps the
@@ -534,6 +579,14 @@ def main(argv=None) -> int:
                          "federate")
     ap.add_argument("--demo", action="store_true",
                     help="dashboard over two in-process demo providers")
+    ap.add_argument("--url", action="append", default=[],
+                    metavar="URL",
+                    help="scrape a live admin endpoint's /metrics.json "
+                         "(repeatable; several URLs federate under a "
+                         "FLEET row)")
+    ap.add_argument("--scrape-timeout", type=float, default=2.0,
+                    help="per-endpoint HTTP deadline for --url "
+                         "(default 2s)")
     ap.add_argument("--cluster", action="store_true",
                     help="treat the directory argument as a supervisor "
                          "snapshot drop and render the cluster.json "
@@ -548,6 +601,10 @@ def main(argv=None) -> int:
 
     if args.demo:
         source = DemoSource()
+    elif args.url:
+        if args.snapshots:
+            ap.error("--url and file/dir sources are mutually exclusive")
+        source = UrlSource(args.url, timeout_s=args.scrape_timeout)
     elif args.cluster:
         if len(args.snapshots) != 1 or not Path(args.snapshots[0]).is_dir():
             ap.error("--cluster requires ONE snapshot directory")

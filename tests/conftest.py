@@ -150,6 +150,14 @@ def pytest_configure(config):
         "cluster: multiprocess shard supervisor, RPC fabric, and "
         "y-websocket gateway tests",
     )
+    # "admin" tags the per-process introspection plane (ISSUE 16):
+    # HTTP admin endpoints, health/readiness probes, scrape-mode
+    # federation, and the bench-regression gate's comparison logic
+    config.addinivalue_line(
+        "markers",
+        "admin: HTTP admin endpoints, health probes, scrape "
+        "federation, and bench-gate tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

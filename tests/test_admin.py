@@ -1,0 +1,816 @@
+"""Per-process introspection plane tests (ISSUE 16).
+
+Covers the AdminServer endpoint surface over a live provider, the
+liveness/readiness split (including the shard fencing-epoch state
+machine), the inflight bound, the env opt-in for library objects, the
+HTTP/file scrape hardening against mid-death races, the
+concurrent-scrape hammer against a flushing provider, and the
+bench-regression gate's comparison logic.
+
+Cluster end-to-end probes (SIGSTOP liveness, mid-recovery readiness,
+fencing over real sockets, HTTP-vs-file federation byte equivalence)
+are additionally marked ``cluster`` — they spawn real shard processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from yjs_tpu.core import Doc
+from yjs_tpu.obs.admin import AdminConfig, AdminServer, maybe_start_admin
+from yjs_tpu.obs.federate import (
+    federate_snapshots,
+    read_snapshot_dir,
+    scrape_endpoints,
+)
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.updates import encode_state_as_update
+
+pytestmark = pytest.mark.admin
+
+
+def _get(url: str, timeout: float = 10.0):
+    """GET -> (status, body bytes); 4xx/5xx don't raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _edit(prov: TpuProvider, room: str, text: str) -> None:
+    d = Doc(gc=False)
+    d.get_text("text").insert(0, text)
+    prov.receive_update(room, encode_state_as_update(d))
+
+
+@pytest.fixture
+def prov_admin():
+    prov = TpuProvider(8)
+    admin = AdminServer(prov, role="provider").start()
+    try:
+        yield prov, admin
+    finally:
+        admin.close()
+        prov.close()
+
+
+# -- endpoint surface ---------------------------------------------------------
+
+
+def test_all_endpoints_answer_over_live_provider(prov_admin):
+    prov, admin = prov_admin
+    _edit(prov, "room0", "hello admin")
+    prov.flush()
+    base = admin.url
+    assert base.startswith("http://127.0.0.1:")
+
+    code, body = _get(base + "/healthz")
+    assert (code, body) == (200, b"ok\n")
+
+    code, body = _get(base + "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert "ytpu_engine_flushes_total" in text
+
+    code, body = _get(base + "/metrics.json")
+    assert code == 200
+    snap = json.loads(body)
+    assert set(snap) >= {"counters", "gauges", "histograms"}
+
+    code, body = _get(base + "/readyz")
+    assert code == 200
+    verdict = json.loads(body)
+    assert verdict["ready"] is True
+    assert verdict["checks"]["recovery_complete"] is True
+
+    code, body = _get(base + "/statusz")
+    assert code == 200
+    status = json.loads(body)
+    assert status["role"] == "provider"
+    assert status["pid"] == os.getpid()
+    assert status["docs"] == 1
+    assert "residue_fraction" in status
+    assert "plan_cache_hit_rate" in status
+    assert status["admission"]["level_name"] in (
+        "normal", "shed-bg", "coalesce", "rej-write"
+    )
+
+    code, body = _get(base + "/debug/blackbox")
+    assert code == 200
+    bb = json.loads(body)
+    assert "stats" in bb and "events" in bb
+
+    code, body = _get(base + "/debug/prof")
+    assert code == 200
+    prof = json.loads(body)
+    assert "device_memory" in prof
+
+    code, body = _get(base + "/debug/trace?n=3")
+    assert code == 200
+    tr = json.loads(body)
+    assert len(tr["events"]) <= 3
+    assert tr["total"] >= len(tr["events"])
+
+    code, body = _get(base + "/nope")
+    assert code == 404
+
+
+def test_metrics_exposition_well_formed(prov_admin):
+    import re
+
+    prov, admin = prov_admin
+    _edit(prov, "roomx", "expo")
+    prov.flush()
+    code, body = _get(admin.url + "/metrics")
+    assert code == 200
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?"
+        r" [-+]?([0-9.eE+-]+|NaN|Inf)( [0-9]+)?$"
+    )
+    for ln in body.decode().splitlines():
+        if ln and not ln.startswith("#"):
+            assert line_re.match(ln), f"malformed exposition line: {ln!r}"
+
+
+def test_request_counter_and_busy_shed():
+    """max_inflight=1 with a blocked handler: the second request is
+    shed with 503 'admin busy' instead of queueing behind the stall."""
+    from yjs_tpu.obs.admin import admin_metrics
+
+    hold = threading.Event()
+    entered = threading.Event()
+
+    class SlowTarget:
+        def statusz(self):
+            entered.set()
+            hold.wait(10)
+            return {"slow": True}
+
+    admin = AdminServer(
+        SlowTarget(), role="slow",
+        config=AdminConfig(max_inflight=1),
+    ).start()
+    try:
+        t = threading.Thread(
+            target=lambda: _get(admin.url + "/statusz"), daemon=True
+        )
+        t.start()
+        assert entered.wait(5)
+        before = admin_metrics().requests.labels(
+            endpoint="/healthz", code=503
+        ).value
+        code, body = _get(admin.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["error"] == "admin busy"
+        after = admin_metrics().requests.labels(
+            endpoint="/healthz", code=503
+        ).value
+        assert after == before + 1
+        hold.set()
+        t.join(timeout=5)
+        # the gate released: the plane serves again
+        assert _get(admin.url + "/healthz")[0] == 200
+    finally:
+        hold.set()
+        admin.close()
+
+
+def test_target_exception_renders_500_and_plane_survives():
+    class BadTarget:
+        def statusz(self):
+            raise RuntimeError("target on fire")
+
+    admin = AdminServer(BadTarget(), role="bad").start()
+    try:
+        code, body = _get(admin.url + "/statusz")
+        assert code == 500
+        err = json.loads(body)
+        assert err["error"] == "RuntimeError"
+        # liveness untouched by the target bug
+        assert _get(admin.url + "/healthz")[0] == 200
+    finally:
+        admin.close()
+
+
+# -- lifecycle / opt-in -------------------------------------------------------
+
+
+def test_maybe_start_admin_env_optin(monkeypatch):
+    monkeypatch.delenv("YTPU_ADMIN_PORT", raising=False)
+    prov = TpuProvider(2)
+    try:
+        assert prov.admin is None  # no env: libraries stay silent
+        assert maybe_start_admin(prov, "provider") is None
+    finally:
+        prov.close()
+
+    monkeypatch.setenv("YTPU_ADMIN_PORT", "0")
+    prov = TpuProvider(2)
+    try:
+        assert prov.admin is not None
+        assert _get(prov.admin.url + "/healthz")[0] == 200
+    finally:
+        prov.close()
+    # close() shut the plane down with the provider
+    assert prov.admin is None or prov.admin._httpd is None
+
+    monkeypatch.setenv("YTPU_ADMIN_DISABLED", "1")
+    prov = TpuProvider(2)
+    try:
+        assert prov.admin is None
+    finally:
+        prov.close()
+
+
+def test_maybe_start_admin_port_collision_yields_none():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    taken = sock.getsockname()[1]
+    try:
+        admin = maybe_start_admin(
+            object(), "provider", config=AdminConfig(port=taken)
+        )
+        assert admin is None
+    finally:
+        sock.close()
+
+
+def test_disabled_config_makes_start_a_noop():
+    admin = AdminServer(None, config=AdminConfig(disabled=True)).start()
+    assert admin.port == 0
+    assert admin.url == ""
+    admin.close()  # no-op, no raise
+
+
+def test_fleet_router_owns_one_plane(monkeypatch):
+    monkeypatch.setenv("YTPU_ADMIN_PORT", "0")
+    from yjs_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(n_shards=2, docs_per_shard=4)
+    try:
+        # per-provider auto-planes were folded into the fleet's one
+        assert all(p.admin is None for p in fleet.shards)
+        assert fleet.admin is not None
+        code, body = _get(fleet.admin.url + "/statusz")
+        assert code == 200
+        status = json.loads(body)
+        assert status["role"] == "fleet"
+        assert status["n_shards"] == 2
+        assert _get(fleet.admin.url + "/readyz")[0] == 200
+    finally:
+        fleet.close()
+
+
+# -- readiness semantics ------------------------------------------------------
+
+
+def test_provider_readyz_flips_on_recovering_and_brownout(prov_admin):
+    prov, admin = prov_admin
+    assert _get(admin.url + "/readyz")[0] == 200
+
+    prov.recovering = True
+    code, body = _get(admin.url + "/readyz")
+    assert code == 503
+    assert json.loads(body)["checks"]["recovery_complete"] is False
+    prov.recovering = False
+    assert _get(admin.url + "/readyz")[0] == 200
+
+    prov.admission.brownout.level = 3  # reject-writes
+    code, body = _get(admin.url + "/readyz")
+    assert code == 503
+    assert json.loads(body)["checks"]["accepting_writes"] is False
+    prov.admission.brownout.level = 0
+    assert _get(admin.url + "/readyz")[0] == 200
+
+
+def test_shard_fencing_epoch_readiness(tmp_path):
+    """The fenced-corpse state machine, driven through the real RPC
+    dispatch seam: witnessing a fleet epoch ahead of the routing epoch
+    flips /readyz 503; the supervisor's epoch push restores it."""
+    from yjs_tpu.cluster.shard import ShardServer
+
+    shard = ShardServer(7, str(tmp_path / "wal7"), n_docs=4)
+    try:
+        base = shard.admin.url
+        assert _get(base + "/readyz")[0] == 200
+
+        # a fence: demoted to replica at epoch 5 (we think we're at 0)
+        shard.handle_rpc_request(
+            "journal_repl_role",
+            {"guid": "roomf", "role": "replica", "epoch": 5,
+             "primary": 1},
+            None,
+        )
+        code, body = _get(base + "/readyz")
+        assert code == 503
+        checks = json.loads(body)["checks"]
+        assert checks["epoch_current"] is False
+        assert checks["epoch_seen"] == 5
+        assert checks["recovery_complete"] is True  # ONLY the fence
+
+        # statusz keeps serving (and shows the lag) while not ready
+        code, body = _get(base + "/statusz")
+        assert code == 200
+        status = json.loads(body)
+        assert status["epoch_seen"] == 5
+        assert status["routing_epoch"] == 0
+
+        # the supervisor's post-resolution push: current again
+        shard.handle_rpc_request("epoch", {"epoch": 6}, None)
+        code, body = _get(base + "/readyz")
+        assert code == 200
+        assert json.loads(body)["checks"]["routing_epoch"] == 6
+    finally:
+        shard.close()
+
+
+def test_shard_recovered_wal_history_does_not_fence(tmp_path):
+    """Replayed repl_role WAL records must NOT raise _epoch_seen: only
+    live control frames fence, else every recovered shard would boot
+    not-ready with no supervisor around to push an epoch."""
+    from yjs_tpu.cluster.shard import ShardServer
+
+    wal = str(tmp_path / "wal0")
+    shard = ShardServer(0, wal, n_docs=4)
+    shard.handle_rpc_request(
+        "journal_repl_role",
+        {"guid": "roomr", "role": "replica", "epoch": 9, "primary": 1},
+        None,
+    )
+    shard.close()
+
+    shard = ShardServer(0, wal, n_docs=4)
+    try:
+        assert shard.recovery["outcome"] == "recovered"
+        assert shard._epoch_seen == 0  # history replayed, not witnessed
+        assert _get(shard.admin.url + "/readyz")[0] == 200
+    finally:
+        shard.close()
+
+
+# -- scrape hardening ---------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_scrape_endpoints_dead_target_is_stale_not_error(prov_admin):
+    from yjs_tpu.obs.federate import fed_metrics
+
+    prov, admin = prov_admin
+    prov.flush()
+    dead = f"127.0.0.1:{_free_port()}"
+    before = fed_metrics().scrape_errors.labels(mode="http").value
+    sources = scrape_endpoints([admin.url, dead], timeout_s=1.0)
+    assert len(sources) == 2
+    live, gone = sources
+    assert live["stale"] is False
+    assert live["snapshot"].get("counters")
+    assert gone["stale"] is True
+    assert gone["snapshot"] == {}
+    assert gone["label"] == dead
+    after = fed_metrics().scrape_errors.labels(mode="http").value
+    assert after == before + 1
+    # federation renders the blank row and names the stale source
+    fed = federate_snapshots(sources)
+    assert fed["federation"]["stale"] == [dead]
+
+
+def test_scrape_endpoints_truncated_body_is_stale():
+    """An endpoint that promises a Content-Length then dies mid-body
+    (the shard was SIGKILLed mid-scrape) must yield a stale entry."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def truncating_server():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 100000\r\n\r\n"
+            b'{"counters": {'
+        )
+        conn.close()  # mid-body: the promised bytes never arrive
+
+    t = threading.Thread(target=truncating_server, daemon=True)
+    t.start()
+    try:
+        sources = scrape_endpoints(
+            [f"127.0.0.1:{port}"], timeout_s=5.0
+        )
+        assert sources[0]["stale"] is True
+        assert sources[0]["snapshot"] == {}
+    finally:
+        srv.close()
+        t.join(timeout=5)
+
+
+def test_read_snapshot_dir_file_deleted_mid_listing(tmp_path, monkeypatch):
+    """A shard dying between listdir and open contributes a stale
+    blank source, never an exception."""
+    from yjs_tpu.obs import federate as fed_mod
+
+    good = tmp_path / "shard-000.json"
+    good.write_text(json.dumps({"counters": {"c": {"": 1}}}))
+    doomed = tmp_path / "shard-001.json"
+    doomed.write_text("{}")
+
+    real_listdir = os.listdir
+
+    def racing_listdir(path):
+        names = real_listdir(path)
+        if doomed.exists():
+            doomed.unlink()  # dies right after the listing
+        return names
+
+    monkeypatch.setattr(fed_mod.os, "listdir", racing_listdir)
+    before = fed_mod.fed_metrics().scrape_errors.labels(mode="file").value
+    sources = read_snapshot_dir(str(tmp_path))
+    assert [s["label"] for s in sources] == ["shard-000", "shard-001"]
+    assert sources[0]["stale"] is False
+    assert sources[1]["stale"] is True
+    after = fed_mod.fed_metrics().scrape_errors.labels(mode="file").value
+    assert after == before + 1
+
+
+def test_read_snapshot_dir_mid_write_torn_json(tmp_path):
+    (tmp_path / "shard-000.json").write_text('{"counters": {"tor')
+    sources = read_snapshot_dir(str(tmp_path))
+    assert sources[0]["stale"] is True
+    assert sources[0]["snapshot"] == {}
+    # federation over the torn dir still renders
+    fed = federate_snapshots(sources)
+    assert fed["federation"]["stale"] == ["shard-000"]
+
+
+# -- concurrency --------------------------------------------------------------
+
+
+def test_concurrent_scrape_hammer_against_flushing_provider():
+    """N scraper threads x every endpoint while the provider flushes:
+    no torn exposition, no deadlock, every response well-formed."""
+    prov = TpuProvider(8)
+    admin = AdminServer(
+        prov, role="provider", config=AdminConfig(max_inflight=16)
+    ).start()
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def flusher():
+        n = 0
+        while not stop.is_set():
+            n += 1
+            _edit(prov, f"room{n % 8}", f"edit {n} ")
+            prov.flush()
+
+    endpoints = (
+        "/metrics", "/metrics.json", "/healthz", "/readyz",
+        "/statusz", "/debug/blackbox", "/debug/prof", "/debug/trace",
+    )
+
+    def scraper(k: int):
+        for i in range(12):
+            ep = endpoints[(k + i) % len(endpoints)]
+            try:
+                code, body = _get(admin.url + ep, timeout=30)
+            except Exception as e:
+                failures.append(f"{ep}: {type(e).__name__}: {e}")
+                continue
+            if code == 503 and ep not in ("/readyz",):
+                continue  # inflight shed under the hammer is legal
+            if code != 200:
+                failures.append(f"{ep}: HTTP {code}")
+            elif ep == "/metrics":
+                import re
+
+                line_re = re.compile(
+                    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?"
+                    r" [-+]?([0-9.eE+-]+|NaN|Inf)$"
+                )
+                for ln in body.decode("utf-8").splitlines():
+                    if ln and not ln.startswith("#") \
+                            and not line_re.match(ln):
+                        failures.append(f"{ep}: torn line {ln!r}")
+                        break
+            elif ep != "/healthz":
+                try:
+                    json.loads(body)
+                except ValueError:
+                    failures.append(f"{ep}: torn JSON")
+
+    ft = threading.Thread(target=flusher, daemon=True)
+    ft.start()
+    threads = [
+        threading.Thread(target=scraper, args=(k,)) for k in range(8)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "scraper deadlocked"
+    finally:
+        stop.set()
+        ft.join(timeout=30)
+        admin.close()
+        prov.close()
+    assert not failures, failures
+
+
+# -- bench-regression gate ----------------------------------------------------
+
+
+def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86):
+    (d / "BENCH_planner.json").write_text(
+        json.dumps({"cold_vs_warm_ratio": planner})
+    )
+    (d / "BENCH_flush.json").write_text(
+        json.dumps({"overlap_fraction": overlap})
+    )
+    (d / "BENCH_cluster.json").write_text(
+        json.dumps({"process": {"converge_ms_p50": p50}})
+    )
+    (d / "BENCH_overload.json").write_text(
+        json.dumps({"shed_fraction": shed})
+    )
+
+
+def test_check_bench_tolerance_bands(tmp_path):
+    import sys
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts"),
+    )
+    try:
+        from check_bench import compare
+    finally:
+        sys.path.pop(0)
+
+    base = tmp_path / "base"
+    fresh = tmp_path / "fresh"
+    base.mkdir()
+    fresh.mkdir()
+    _write_baselines(base)
+
+    # identical numbers: all ok
+    _write_baselines(fresh)
+    assert all(
+        v["status"] == "ok" for v in compare(fresh, base, {})
+    )
+
+    # better in the metric's own direction never fails
+    _write_baselines(fresh, planner=1.0, overlap=0.99, p50=1.0, shed=0.99)
+    assert all(
+        v["status"] == "ok" for v in compare(fresh, base, {})
+    )
+
+    # each metric regressed past its band fails, direction-aware
+    _write_baselines(fresh, planner=99.0, overlap=0.1, p50=99.0, shed=0.1)
+    verdicts = compare(fresh, base, {})
+    assert all(v["status"] == "regression" for v in verdicts)
+
+    # inside the band: jitter passes
+    _write_baselines(
+        fresh, planner=2.0 * 1.3, overlap=0.85 * 0.9,
+        p50=2.5 * 1.5, shed=0.86 * 0.95,
+    )
+    assert all(v["status"] == "ok" for v in compare(fresh, base, {}))
+
+    # a silently-dead bench block is itself a failure
+    (fresh / "BENCH_flush.json").unlink()
+    verdicts = {v["metric"]: v for v in compare(fresh, base, {})}
+    assert verdicts["flush.overlap_fraction"]["status"] == "missing-fresh"
+
+    # tolerance override flips a verdict
+    _write_baselines(fresh, planner=2.0 * 1.6)
+    verdicts = {v["metric"]: v for v in compare(fresh, base, {})}
+    assert verdicts["planner.cold_vs_warm_ratio"]["status"] == "regression"
+    verdicts = {
+        v["metric"]: v
+        for v in compare(
+            fresh, base, {"planner.cold_vs_warm_ratio": 1.0}
+        )
+    }
+    assert verdicts["planner.cold_vs_warm_ratio"]["status"] == "ok"
+
+
+# -- cluster end-to-end -------------------------------------------------------
+
+
+FAST = dict(heartbeat_s=0.1, restart_backoff_s=0.05, spawn_timeout_s=120.0)
+
+
+@pytest.mark.cluster
+def test_cluster_admin_everywhere_and_federation_equivalence(tmp_path):
+    """Every process serves the plane, and HTTP-scrape federation is
+    byte-equivalent with the file-drop mode over the SAME payloads."""
+    from yjs_tpu.cluster import (
+        ClusterConfig, Gateway, GatewayConfig, Supervisor,
+    )
+
+    sup = Supervisor(
+        3, str(tmp_path / "wal"), docs_per_shard=4,
+        config=ClusterConfig(snapshot_dir="", **FAST),
+    ).start()
+    gw = Gateway(sup, config=GatewayConfig(port=0)).start()
+    try:
+        urls = sup.admin_urls()
+        assert set(urls) == {
+            "supervisor", "shard-000", "shard-001", "shard-002"
+        }
+        urls["gateway"] = gw.admin.url
+        for name, base in urls.items():
+            assert _get(base + "/healthz")[0] == 200, name
+            assert _get(base + "/readyz")[0] == 200, name
+            code, body = _get(base + "/statusz")
+            assert code == 200, name
+            status = json.loads(body)
+            expect = name.split("-")[0] if name.startswith("shard") else name
+            assert status["role"] == expect
+            code, body = _get(base + "/metrics")
+            assert code == 200 and b"ytpu_" in body, name
+
+        srcs = sup.scrape_sources()
+        assert [s["label"] for s in srcs] == [
+            "shard-000", "shard-001", "shard-002"
+        ]
+        assert not any(s["stale"] for s in srcs)
+        out = sup.dump_snapshots(path=str(tmp_path / "snap"), sources=srcs)
+        file_srcs = [
+            s for s in read_snapshot_dir(out) if s["label"] != "cluster"
+        ]
+        via_http = json.dumps(federate_snapshots(srcs), sort_keys=True)
+        via_file = json.dumps(
+            federate_snapshots(file_srcs), sort_keys=True
+        )
+        assert via_http == via_file
+    finally:
+        gw.close()
+        sup.close()
+
+
+@pytest.mark.cluster
+def test_cluster_kill_shard_mid_scrape_yields_stale_row(tmp_path):
+    """SIGKILL a shard, scrape immediately: its row is stale-marked,
+    the others merge, federation never raises."""
+    from yjs_tpu.cluster import ClusterConfig, Supervisor
+
+    sup = Supervisor(
+        2, str(tmp_path / "wal"), docs_per_shard=4,
+        config=ClusterConfig(
+            snapshot_dir="", restart_max=0, probe_timeout_s=60.0,
+            scrape_timeout_s=1.0, **FAST,
+        ),
+    ).start()
+    try:
+        victim = sup._shards[0].pid
+        os.kill(victim, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            srcs = sup.scrape_sources()
+            if srcs[0]["stale"]:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"killed shard never went stale: {srcs}")
+        assert srcs[0]["label"] == "shard-000"
+        assert srcs[1]["stale"] is False
+        fed = federate_snapshots(srcs)
+        assert fed["federation"]["stale"] == ["shard-000"]
+    finally:
+        sup.close()
+
+
+@pytest.mark.cluster
+def test_cluster_healthz_flips_on_sigstop(tmp_path):
+    """/healthz is pure liveness: a SIGSTOPped (hung) shard times the
+    probe out; SIGCONT restores it.  probe_timeout_s is generous so
+    the supervisor doesn't restart the shard under the test."""
+    from yjs_tpu.cluster import ClusterConfig, Supervisor
+
+    sup = Supervisor(
+        1, str(tmp_path / "wal"), docs_per_shard=4,
+        config=ClusterConfig(
+            snapshot_dir="", probe_timeout_s=600.0, **FAST
+        ),
+    ).start()
+    pid = sup._shards[0].pid
+    stopped = False
+    try:
+        base = sup.admin_urls()["shard-000"]
+        assert _get(base + "/healthz")[0] == 200
+
+        os.kill(pid, signal.SIGSTOP)
+        stopped = True
+        with pytest.raises(OSError):
+            urllib.request.urlopen(base + "/healthz", timeout=1.0)
+
+        os.kill(pid, signal.SIGCONT)
+        stopped = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if _get(base + "/healthz", timeout=2.0)[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("healthz never recovered after SIGCONT")
+    finally:
+        if stopped:
+            os.kill(pid, signal.SIGCONT)
+        sup.close()
+
+
+@pytest.mark.cluster
+def test_readyz_flips_during_wal_recovery(tmp_path):
+    """A shard replaying a big WAL answers /healthz 200 and /readyz
+    503 (recovery_complete false) until replay completes, then 200 —
+    the plane comes up BEFORE the provider."""
+    from yjs_tpu.cluster.shard import ShardServer
+
+    wal = str(tmp_path / "wal")
+    prov = TpuProvider(8, wal_dir=wal)
+    for i in range(400):
+        _edit(prov, f"room{i % 8}", f"record {i} " * 8)
+    prov.flush()
+    prov.close()
+
+    admin_port = _free_port()
+    built: dict = {}
+
+    def build():
+        built["shard"] = ShardServer(
+            0, wal, n_docs=8, admin_port=admin_port
+        )
+
+    t = threading.Thread(target=build, daemon=True)
+    base = f"http://127.0.0.1:{admin_port}"
+    codes: list[int] = []
+    t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while t.is_alive() and time.monotonic() < deadline:
+            try:
+                codes.append(_get(base + "/readyz", timeout=2.0)[0])
+            except OSError:
+                pass  # socket not bound yet
+        t.join(timeout=120)
+        assert "shard" in built, "shard construction failed"
+        # during replay the plane answered, and answered NOT READY
+        assert 503 in codes, f"never saw 503 during recovery: {codes}"
+        assert _get(base + "/readyz")[0] == 200
+        assert built["shard"].recovery["outcome"] == "recovered"
+    finally:
+        sh = built.get("shard")
+        if sh is not None:
+            sh.close()
+
+
+@pytest.mark.cluster
+def test_cluster_fencing_flips_readyz_over_real_sockets(tmp_path):
+    """The fence window end-to-end: a live shard witnessing a fleet
+    epoch ahead of its routing epoch (the frame a real failover sends
+    to a stale primary) goes 503 until the supervisor's broadcast."""
+    from yjs_tpu.cluster import ClusterConfig, Supervisor
+
+    sup = Supervisor(
+        2, str(tmp_path / "wal"), docs_per_shard=4,
+        config=ClusterConfig(snapshot_dir="", **FAST),
+    ).start()
+    try:
+        base = sup.admin_urls()["shard-000"]
+        assert _get(base + "/readyz")[0] == 200
+        # the fence frame, over the real RPC socket
+        sup._call(0, "journal_repl_role", {
+            "guid": "roomf", "role": "replica", "epoch": 3,
+            "primary": 1,
+        })
+        code, body = _get(base + "/readyz")
+        assert code == 503
+        assert json.loads(body)["checks"]["epoch_current"] is False
+        # the supervisor's post-resolution push restores readiness
+        sup._broadcast_epoch(4)
+        assert _get(base + "/readyz")[0] == 200
+    finally:
+        sup.close()

@@ -341,6 +341,7 @@ def test_federate_counters_sum_gauges_keep_shards():
     assert lat["p50"] == pytest.approx(2.0)
     assert fed["federation"] == {
         "sources": 2, "roles": {"0": "primary", "1": "replica"},
+        "stale": [],
     }
 
 

@@ -41,8 +41,8 @@ RULE_METRIC = "metric-drift"
 # YTPU_FUZZ_ITERS are documented without being read by the package)
 KNOB_PREFIXES = (
     "CHAOS", "RESILIENCE", "DLQ", "WAL", "PROF", "SLO", "NET", "FLEET",
-    "TIER", "REPL", "FAILOVER", "PLAN", "ADM", "TRACE", "BLACKBOX",
-    "FLUSH", "LINT", "CLUSTER", "GATEWAY",
+    "TIER", "REPL", "FAILOVER", "PLAN", "ADM", "ADMIN", "TRACE",
+    "BLACKBOX", "FLUSH", "LINT", "CLUSTER", "GATEWAY",
 )
 
 KNOB_RE = re.compile(
@@ -306,6 +306,13 @@ def live_comparison(root) -> list:
     _GatewayMetricsSingleton.get()
     rpc_metrics()
     _ClusterMetrics()
+    # ... as are the admin-plane and federation-scrape families
+    # (ISSUE 16): first request / first scrape registers them
+    from yjs_tpu.obs.admin import admin_metrics
+    from yjs_tpu.obs.federate import fed_metrics
+
+    admin_metrics()
+    fed_metrics()
     live = set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

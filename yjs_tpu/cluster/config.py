@@ -48,6 +48,7 @@ class ClusterConfig:
         "spawn_timeout_s",
         "rpc_timeout_s",
         "busy_retry_ticks",
+        "scrape_timeout_s",
     )
 
     def __init__(
@@ -62,6 +63,7 @@ class ClusterConfig:
         spawn_timeout_s: float | None = None,
         rpc_timeout_s: float | None = None,
         busy_retry_ticks: int | None = None,
+        scrape_timeout_s: float | None = None,
     ):
         self.host = (
             host
@@ -115,6 +117,14 @@ class ClusterConfig:
             busy_retry_ticks
             if busy_retry_ticks is not None
             else _env_int("YTPU_CLUSTER_BUSY_RETRY_TICKS", 8, lo=1)
+        )
+        # per-target deadline for one HTTP admin-plane scrape during
+        # metrics federation (ISSUE 16): a hung shard costs at most
+        # this long and renders as a stale row, never an error
+        self.scrape_timeout_s = (
+            scrape_timeout_s
+            if scrape_timeout_s is not None
+            else _env_float("YTPU_CLUSTER_SCRAPE_TIMEOUT_S", 2.0)
         )
 
 

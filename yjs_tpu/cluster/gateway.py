@@ -695,6 +695,7 @@ class Gateway:
         self._ticker = threading.Thread(
             target=self._tick_loop, name="ytpu-gateway-tick", daemon=True
         )
+        self.admin = None  # started alongside the loops in start()
         cluster.on_update = self._on_room_update
         cluster.on_epoch = self._on_epoch
 
@@ -705,10 +706,44 @@ class Gateway:
     def start(self) -> "Gateway":
         self._accept.start()
         self._ticker.start()
+        from ..obs.admin import AdminServer
+
+        try:
+            self.admin = AdminServer(self, role="gateway").start()
+        except OSError:
+            self.admin = None  # port taken; ws plane still serves
         return self
+
+    # -- admin-plane target (ISSUE 16) ---------------------------------------
+
+    def statusz(self) -> dict:
+        with self._lock:
+            n_conns = len(self._conns)
+            rooms = {r: len(cs) for r, cs in self._rooms.items()}
+        epoch = getattr(self.cluster, "epoch", None)
+        return {
+            "role": "gateway",
+            "port": self.port,
+            "conns": n_conns,
+            "rooms": rooms,
+            "epoch": epoch() if callable(epoch) else epoch,
+        }
+
+    def readiness(self) -> dict:
+        """Ready once the accept loop is live and the cluster facade is
+        still attached — a closing gateway flips not-ready first."""
+        accepting = self._accept.is_alive() and not self._stop.is_set()
+        return {
+            "ready": accepting,
+            "checks": {"accepting": accepting},
+        }
 
     def close(self) -> None:
         self._stop.set()
+        admin = getattr(self, "admin", None)
+        if admin is not None:
+            admin.close()
+            self.admin = None
         try:
             self._sock.close()
         except OSError:

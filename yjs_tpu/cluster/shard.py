@@ -33,6 +33,7 @@ import sys
 import threading
 
 from ..obs import dist as obs_dist
+from ..obs.admin import AdminConfig, AdminServer
 from .config import ClusterConfig
 from .rpc import RpcBusy, RpcServer, b64d, b64e
 
@@ -50,6 +51,7 @@ class ShardServer:
         backend: str = "cpu",
         tick_s: float = 0.05,
         config: ClusterConfig | None = None,
+        admin_port: int | None = None,
     ):
         from ..provider import TpuProvider
 
@@ -58,6 +60,27 @@ class ShardServer:
         self.tick_s = tick_s
         self._plock = threading.RLock()
         self._stop = threading.Event()
+        # fencing-epoch currency (ISSUE 16 readiness): the highest
+        # fleet epoch any control frame carried, vs the epoch the
+        # supervisor last TOLD us is current.  A fence (demotion to
+        # replica at epoch E) raises _epoch_seen past routing_epoch,
+        # and /readyz answers 503 until the post-resolution epoch push
+        # catches us up — the "fenced corpse" window.
+        self.routing_epoch = 0
+        self._epoch_seen = 0
+        self._init_done = False
+        # the admin plane starts BEFORE the provider is built so
+        # /healthz answers (and /readyz says 503 "recovering") during a
+        # long WAL replay — exactly the window probes care about
+        self.admin: AdminServer | None = None
+        try:
+            self.admin = AdminServer(
+                self,
+                role="shard",
+                config=AdminConfig(port=admin_port),
+            ).start()
+        except OSError:
+            self.admin = None  # port taken: serve data plane anyway
         has_wal = os.path.isdir(wal_dir) and any(
             os.scandir(wal_dir)
         )
@@ -84,7 +107,6 @@ class ShardServer:
             )
             self.recovery = {"outcome": "fresh"}
         self.provider.shard_id = self.shard_id
-        self.routing_epoch = 0
         # journal-only replica copies (PR 8 fan-out over sockets): the
         # engine never sees these, so WAL compaction would destroy them
         # — checkpoints fold only engine-resident docs.  Track them
@@ -105,10 +127,96 @@ class ShardServer:
             daemon=True,
         )
         self._ticker.start()
+        self._init_done = True
 
     @property
     def port(self) -> int:
         return self.server.port
+
+    @property
+    def admin_port(self) -> int:
+        return self.admin.port if self.admin is not None else 0
+
+    # -- admin-plane target (ISSUE 16) ---------------------------------------
+
+    def metrics_text(self) -> str:
+        prov = getattr(self, "provider", None)
+        if prov is None:
+            from ..obs import global_registry, prometheus_text
+
+            return prometheus_text(global_registry())
+        return prov.metrics_text()
+
+    def metrics_snapshot(self) -> dict:
+        """The federation payload: the provider's full snapshot plus
+        the shard identity keys — byte-identical to what the supervisor
+        writes as ``shard-K.json``, so HTTP-scrape and file-drop
+        federation merge the exact same input."""
+        prov = getattr(self, "provider", None)
+        if prov is None:
+            snap = {}
+        else:
+            with self._plock:
+                snap = prov.metrics_snapshot()
+        snap["shard"] = self.shard_id
+        snap["pid"] = os.getpid()
+        snap["label"] = f"shard-{self.shard_id:03d}"
+        snap["role"] = "primary"
+        return snap
+
+    def statusz(self) -> dict:
+        prov = getattr(self, "provider", None)
+        if prov is None:
+            status = {"recovering": True}
+        else:
+            with self._plock:
+                status = prov.statusz()
+        status.update({
+            "role": "shard",
+            "shard": self.shard_id,
+            "rpc_port": self.server.port if self._init_done else 0,
+            "routing_epoch": self.routing_epoch,
+            "epoch_seen": self._epoch_seen,
+            "recovery": getattr(self, "recovery", {}),
+        })
+        return status
+
+    def readiness(self) -> dict:
+        """``/readyz``: not ready while the provider is still being
+        built/recovered, while brownout rejects writes, or while this
+        shard's routing epoch lags a fence it witnessed (a stale
+        primary must not take traffic until the supervisor publishes
+        the post-resolution epoch).  Lock-free on purpose — reads are
+        plain attributes, so a wedged provider lock cannot wedge the
+        probe (liveness stays /healthz's job)."""
+        prov = getattr(self, "provider", None)
+        recovering = (
+            not self._init_done
+            or prov is None
+            or getattr(prov, "recovering", False)
+        )
+        level = (
+            prov.admission.brownout.level if prov is not None else 0
+        )
+        current = self.routing_epoch >= self._epoch_seen
+        ready = (not recovering) and level < 3 and current
+        return {
+            "ready": ready,
+            "checks": {
+                "recovery_complete": not recovering,
+                "brownout_level": level,
+                "accepting_writes": level < 3,
+                "epoch_current": current,
+                "routing_epoch": self.routing_epoch,
+                "epoch_seen": self._epoch_seen,
+            },
+        }
+
+    def trace_events(self) -> list:
+        prov = getattr(self, "provider", None)
+        if prov is None:
+            return []
+        return prov.trace_events()
 
     def _on_flush_update(self, guid: str, update: bytes) -> None:
         # flush-emitted merged update: push to every RPC subscriber
@@ -199,10 +307,10 @@ class ShardServer:
         if method == "checkpoint":
             return {"checkpoint": bool(self._checkpoint())}
         if method == "metrics":
-            snap = prov.metrics_snapshot()
-            snap["shard"] = self.shard_id
-            snap["pid"] = os.getpid()
-            return {"snapshot": snap}
+            # same payload the admin plane serves at /metrics.json —
+            # RPC fallback and HTTP scrape federate identical input
+            # (_plock is an RLock; re-entering here is fine)
+            return {"snapshot": self.metrics_snapshot()}
         if method == "journal_ack":
             prov.journal_session_ack(
                 payload["guid"], payload["peer"],
@@ -233,6 +341,10 @@ class ShardServer:
                 int(payload["epoch"]),
                 primary=payload.get("primary"),
             )
+            # witnessing a fleet epoch ahead of our routing epoch (a
+            # fence/demotion decided while we were dead) flips /readyz
+            # until the supervisor's post-resolution epoch push
+            self._epoch_seen = max(self._epoch_seen, int(payload["epoch"]))
             self._replica_roles[guid] = {
                 "role": str(role),
                 "epoch": int(payload["epoch"]),
@@ -264,10 +376,13 @@ class ShardServer:
             return {"update": b64e(final)}
         if method == "epoch":
             # routing-epoch bump (fencing, PR 8): a shard holding a
-            # lower epoch than the fleet's learns it here
+            # lower epoch than the fleet's learns it here — this is
+            # the "you are current again" signal that restores /readyz
+            # after a fence raised _epoch_seen
             self.routing_epoch = max(
                 self.routing_epoch, int(payload["epoch"])
             )
+            self._epoch_seen = max(self._epoch_seen, self.routing_epoch)
             return {"epoch": self.routing_epoch}
         if method == "shutdown":
             self._stop.set()
@@ -337,6 +452,8 @@ class ShardServer:
         self._stop.set()
         if self._ticker.is_alive():
             self._ticker.join(timeout=2.0)
+        if self.admin is not None:
+            self.admin.close()
         self.server.close()
         with self._plock:
             try:
@@ -363,6 +480,11 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--backend", default="cpu")
     ap.add_argument("--tick-s", type=float, default=0.05)
+    ap.add_argument(
+        "--admin-port", type=int, default=None,
+        help="admin-plane HTTP port (default: YTPU_ADMIN_PORT or 0; "
+        "YTPU_ADMIN_DISABLED=1 turns the plane off)",
+    )
     args = ap.parse_args(argv)
 
     shard = ShardServer(
@@ -373,11 +495,13 @@ def main(argv=None) -> int:
         port=args.port,
         backend=args.backend,
         tick_s=args.tick_s,
+        admin_port=args.admin_port,
     )
     ready = {
         "shard": shard.shard_id,
         "port": shard.port,
         "pid": os.getpid(),
+        "admin_port": shard.admin_port,
         "recovery": shard.recovery,
     }
     sys.stdout.write(

@@ -63,7 +63,7 @@ class _ShardProc:
 
     __slots__ = (
         "shard_id", "wal_dir", "proc", "port", "pid", "client",
-        "restarts", "state", "recovery", "probe_fails",
+        "restarts", "state", "recovery", "probe_fails", "admin_port",
     )
 
     def __init__(self, shard_id: int, wal_dir: str):
@@ -77,6 +77,7 @@ class _ShardProc:
         self.state = "starting"  # starting|live|restarting|lost
         self.recovery = {}
         self.probe_fails = 0  # consecutive unanswered heartbeat probes
+        self.admin_port = 0  # the child's introspection-plane port
 
     def row(self) -> dict:
         return {
@@ -84,6 +85,7 @@ class _ShardProc:
             "state": self.state,
             "pid": self.pid,
             "port": self.port,
+            "admin_port": self.admin_port,
             "restarts": self.restarts,
             "outcome": self.recovery.get("outcome", ""),
             "records_applied": self.recovery.get("records_applied", 0),
@@ -162,16 +164,26 @@ class Supervisor:
         )
         self.on_update = None  # callable(guid: str, update: bytes)
         self.on_epoch = None   # callable(epoch: int, shards: list[int])
+        # the supervisor's own introspection plane (ISSUE 16): serves
+        # the FEDERATED cluster view at /metrics.json, so one scrape of
+        # the supervisor renders the whole cluster
+        self.admin = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "Supervisor":
+        from ..obs.admin import AdminServer
+
         with self._lock:
             shards = list(self._shards.values())
         for sp in shards:
             self._spawn(sp)
         self._monitor.start()
         self._evt_thread.start()
+        try:
+            self.admin = AdminServer(self, role="supervisor").start()
+        except OSError:
+            self.admin = None
         return self
 
     def _spawn(self, sp: _ShardProc) -> None:
@@ -186,6 +198,11 @@ class Supervisor:
             "--port", "0",
             "--backend", self.backend,
             "--tick-s", str(self.shard_tick_s),
+            # every child gets an ephemeral admin port: a fixed
+            # YTPU_ADMIN_PORT in the supervisor's env must not make N
+            # children fight over one socket (YTPU_ADMIN_DISABLED=1
+            # still turns the plane off)
+            "--admin-port", "0",
         ]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -209,6 +226,7 @@ class Supervisor:
             sp.pid = ready["pid"]
             sp.client = client
             sp.recovery = ready.get("recovery") or {}
+            sp.admin_port = int(ready.get("admin_port") or 0)
             sp.state = "live"
             sp.probe_fails = 0
             live = sum(
@@ -271,6 +289,9 @@ class Supervisor:
 
     def close(self) -> None:
         self._stop.set()
+        if self.admin is not None:
+            self.admin.close()
+            self.admin = None
         with self._evt_wake:
             self._evt_wake.notify_all()
         if self._monitor.is_alive():
@@ -612,12 +633,29 @@ class Supervisor:
             event["epoch"] = epoch
             event["unavailable_s"] = round(dt, 4)
             self._events.append(event)
+        # publish the post-resolution epoch to every live shard: a
+        # fenced restartee saw epoch E in its demotion frames and is
+        # reporting /readyz 503 until this push tells it E+1 is current
+        # (ISSUE 16 fencing-epoch readiness)
+        self._broadcast_epoch(epoch)
         cb = self.on_epoch
         if cb is not None:
             try:
                 cb(epoch, [sp.shard_id])
             except Exception:
                 pass
+
+    def _broadcast_epoch(self, epoch: int) -> None:
+        with self._lock:
+            ids = [
+                sp.shard_id for sp in self._shards.values()
+                if sp.state == "live"
+            ]
+        for k in ids:
+            try:
+                self._call(k, "epoch", {"epoch": int(epoch)})
+            except RpcError:
+                pass  # a shard mid-restart learns it on the next bump
 
     def _resolve_after_restart(self, sp: _ShardProc) -> dict:
         """Mirror ``FleetRouter.recover``'s ownership resolution across
@@ -784,50 +822,132 @@ class Supervisor:
             "resolution": totals,
         }
 
-    def metrics_snapshot(self) -> dict:
-        """Federated view over every live shard's registry plus the
-        supervisor's own process-global families."""
-        sources = []
+    def scrape_sources(self) -> list[dict]:
+        """One federation source per shard, scraped over the admin
+        plane's HTTP ``/metrics.json`` (ISSUE 16) with the RPC
+        ``metrics`` call as fallback for admin-disabled children.  A
+        dead/hung shard yields a stale-marked empty source under the
+        per-target ``scrape_timeout_s`` — partial failure renders as a
+        blank row, never a federation error."""
+        from ..obs.federate import scrape_endpoints
+
         with self._lock:
-            ids = sorted(self._shards)
-        for k in ids:
-            try:
-                snap = self._call(k, "metrics", {})["snapshot"]
-            except RpcError:
-                snap = {}
-            sources.append({
-                "label": f"shard-{k:03d}",
-                "role": "primary",
-                "snapshot": snap,
-            })
+            targets = [
+                (k, self._shards[k].admin_port, self._shards[k].state)
+                for k in sorted(self._shards)
+            ]
+        sources = []
+        for k, admin_port, state in targets:
+            label = f"shard-{k:03d}"
+            if admin_port:
+                src = scrape_endpoints(
+                    [f"http://{self.config.host}:{admin_port}"],
+                    timeout_s=self.config.scrape_timeout_s,
+                )[0]
+                src["label"] = label
+                src["role"] = src["role"] or "primary"
+            else:
+                snap: dict = {}
+                stale = True
+                if state == "live":
+                    try:
+                        snap = self._call(k, "metrics", {})["snapshot"]
+                        stale = False
+                    except RpcError:
+                        snap = {}
+                src = {
+                    "label": label,
+                    "role": "primary",
+                    "snapshot": snap,
+                    "stale": stale,
+                }
+            sources.append(src)
+        return sources
+
+    def metrics_snapshot(self) -> dict:
+        """Federated view over every shard's registry (HTTP scrape,
+        RPC fallback) plus the supervisor's own process-global
+        families."""
         return federate_snapshots(
-            sources, global_snapshot=registry_snapshot(global_registry())
+            self.scrape_sources(),
+            global_snapshot=registry_snapshot(global_registry()),
         )
 
-    def dump_snapshots(self, path: str | None = None) -> str:
+    def dump_snapshots(
+        self, path: str | None = None, sources: list[dict] | None = None
+    ) -> str:
         """Write per-shard ``shard-K.json`` metric snapshots plus the
         ``cluster.json`` recovery report into the snapshot dir — the
-        ``obs/federate.py`` file-drop format ``ytpu_top`` tails."""
+        ``obs/federate.py`` file-drop format ``ytpu_top <dir>`` tails
+        and the HTTP-scrape mode is byte-equivalent with (both paths
+        dump/serve the same shard payload).  ``sources`` reuses an
+        existing scrape; stale sources keep the last good file."""
         out = path or self.config.snapshot_dir
         if not out:
             raise ValueError(
                 "no snapshot dir (YTPU_CLUSTER_SNAPSHOT_DIR or path=)"
             )
         os.makedirs(out, exist_ok=True)
-        with self._lock:
-            ids = sorted(self._shards)
-        for k in ids:
-            try:
-                snap = self._call(k, "metrics", {})["snapshot"]
-            except RpcError:
+        if sources is None:
+            sources = self.scrape_sources()
+        for src in sources:
+            if src.get("stale"):
                 continue
-            tmp = os.path.join(out, f".shard-{k:03d}.json.tmp")
+            name = str(src["label"])
+            tmp = os.path.join(out, f".{name}.json.tmp")
             with open(tmp, "w") as f:
-                json.dump(snap, f)
-            os.replace(tmp, os.path.join(out, f"shard-{k:03d}.json"))
+                json.dump(src["snapshot"], f)
+            os.replace(tmp, os.path.join(out, f"{name}.json"))
         report = self.recovery_report()
         tmp = os.path.join(out, ".cluster.json.tmp")
         with open(tmp, "w") as f:
             json.dump(report, f, indent=1)
         os.replace(tmp, os.path.join(out, "cluster.json"))
         return out
+
+    # -- admin-plane target (ISSUE 16) ---------------------------------------
+
+    def admin_urls(self) -> dict[str, str]:
+        """Every process's admin base URL: the supervisor's own plus
+        one per live shard child (the smoke harness curls them all)."""
+        urls: dict[str, str] = {}
+        if self.admin is not None and self.admin.port:
+            urls["supervisor"] = self.admin.url
+        with self._lock:
+            for k in sorted(self._shards):
+                sp = self._shards[k]
+                if sp.admin_port:
+                    urls[f"shard-{k:03d}"] = (
+                        f"http://{self.config.host}:{sp.admin_port}"
+                    )
+        return urls
+
+    def statusz(self) -> dict:
+        report = self.recovery_report()
+        return {
+            "role": "supervisor",
+            "epoch": report["epoch"],
+            "shards": report["shards"],
+            "outcomes": report["outcomes"],
+            "resolution": report["resolution"],
+            "events": len(report["events"]),
+        }
+
+    def readiness(self) -> dict:
+        """``/readyz`` for the control plane: every shard settled (live
+        or failed-over) and at least one serving — a shard mid-restart
+        flips the cluster not-ready until recovery resolves."""
+        with self._lock:
+            states = [sp.state for sp in self._shards.values()]
+        live = sum(1 for s in states if s == "live")
+        settled = all(s in ("live", "lost") for s in states)
+        return {
+            "ready": live > 0 and settled,
+            "checks": {
+                "live_shards": live,
+                "all_settled": settled,
+                "states": {
+                    s: states.count(s) for s in sorted(set(states))
+                },
+            },
+        }

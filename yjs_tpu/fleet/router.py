@@ -44,8 +44,9 @@ from pathlib import Path
 from ..admission import AdmissionController
 from ..obs import dist as obs_dist
 from ..obs import global_registry
+from ..obs.admin import maybe_start_admin
 from ..obs.blackbox import flight_recorder
-from ..obs.expo import registry_snapshot
+from ..obs.expo import prometheus_text, registry_snapshot
 from ..obs.federate import FederationMetrics, federate_snapshots
 from ..provider import ProviderFullError, TpuProvider
 from ..sync.session import (
@@ -384,6 +385,14 @@ class FleetRouter:
             self, metrics=self.failover_metrics
         )
         self.rebalancer = Rebalancer(self)
+        # admin plane (ISSUE 16): ONE endpoint for the whole fleet —
+        # shard providers that auto-started their own (YTPU_ADMIN_PORT
+        # set) hand the plane over to the router's federated view
+        for prov in self.shards:
+            if getattr(prov, "admin", None) is not None:
+                prov.admin.close()
+                prov.admin = None
+        self.admin = maybe_start_admin(self, "fleet")
         self._refresh_gauges()
 
     # -- construction helpers ------------------------------------------------
@@ -667,6 +676,9 @@ class FleetRouter:
         return out
 
     def close(self, checkpoint: bool = True) -> None:
+        if getattr(self, "admin", None) is not None:
+            self.admin.close()
+            self.admin = None
         for k, p in enumerate(self.shards):
             if not self._is_stub(k):
                 p.close(checkpoint=checkpoint)
@@ -1135,6 +1147,62 @@ class FleetRouter:
         snap["sessions"] = self.sessions_snapshot()
         snap["admission"] = self.admission.snapshot()
         return snap
+
+    # -- admin-plane surface (ISSUE 16) -------------------------------------
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition over every live shard's registry plus
+        the process-global families — the fleet's ``/metrics`` body."""
+        regs = [
+            p.engine.obs.registry
+            for k, p in enumerate(self.shards)
+            if not self._is_stub(k)
+        ]
+        regs.append(global_registry())
+        return prometheus_text(*regs)
+
+    def statusz(self) -> dict:
+        """The fleet's ``/statusz`` page: topology epoch, per-shard
+        occupancy rows, session table, and admission verdict."""
+        fs = self.fleet_snapshot()
+        adm = fs["admission"]
+        return {
+            "role": "fleet",
+            "epoch": fs["epoch"],
+            "n_shards": fs["n_shards"],
+            "live_shards": fs["live_shards"],
+            "docs": fs["docs"],
+            "capacity": fs["capacity"],
+            "migrations_active": fs["migrations_active"],
+            "shards": fs["shards"],
+            "sessions": self.sessions_snapshot(),
+            "admission": {
+                "level": adm["level"],
+                "level_name": adm["level_name"],
+                "queue_depth": adm["queue_depth"],
+            },
+        }
+
+    def readiness(self) -> dict:
+        """``/readyz`` for the in-process fleet: at least one live
+        shard, no shard mid-recovery, brownout below reject-writes."""
+        live = len(self.live_shards)
+        recovering = any(
+            getattr(p, "recovering", False)
+            for k, p in enumerate(self.shards)
+            if not self._is_stub(k)
+        )
+        level = self.admission.brownout.level
+        ready = live > 0 and not recovering and level < 3
+        return {
+            "ready": ready,
+            "checks": {
+                "live_shards": live,
+                "recovery_complete": not recovering,
+                "brownout_level": level,
+                "accepting_writes": level < 3,
+            },
+        }
 
     def recovery_report(self) -> dict:
         """Per-shard recovery outcomes in the SAME structured shape the

@@ -59,9 +59,16 @@ from .dist import (  # noqa: F401
 )
 from .federate import (  # noqa: F401
     FederationMetrics,
+    fed_metrics,
     federate_snapshots,
     merge_summaries,
     read_snapshot_dir,
+    scrape_endpoints,
+)
+from .admin import (  # noqa: F401
+    AdminConfig,
+    AdminServer,
+    maybe_start_admin,
 )
 
 SNAPSHOT_SCHEMA_VERSION = 1
@@ -244,6 +251,17 @@ class EngineObs:
             "Device row capacity (per doc) after last flush",
             unit="rows",
         )
+        # segment-planner residue (ISSUE 16 satellite): the live number
+        # the residue-elimination work drives against — fraction of
+        # planned structs the device fast path could NOT place and
+        # handed to the sequential YATA conflict fallback
+        self._segment_residue_fraction = r.gauge(
+            "ytpu_plan_segment_residue_fraction",
+            "Fraction of planned structs handed to the sequential YATA "
+            "conflict fallback, last flush with planner work "
+            "(residue / (fast + residue))",
+            unit="ratio",
+        )
         self._flush_seconds = r.histogram(
             "ytpu_engine_flush_seconds", "End-to-end flush wall time",
             unit="s",
@@ -372,6 +390,14 @@ class EngineObs:
         self._flush_seconds.observe(metrics["t_total_s"])
         for ph, child in self._phase_children.items():
             child.observe(metrics[f"t_{ph}_s"])
+        planned = (
+            metrics["plan_segment_fast"] + metrics["plan_segment_residue"]
+        )
+        if planned:
+            # idle flushes keep the last real verdict on the gauge
+            self._segment_residue_fraction.set(
+                metrics["plan_segment_residue"] / planned
+            )
         self._flush_pipeline_depth.set(metrics["pipeline_depth"])
         self._flush_pack_overlap.observe(metrics["t_pack_overlap_s"])
         self._flush_device_wait.observe(metrics["t_device_wait_s"])

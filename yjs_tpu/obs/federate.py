@@ -15,29 +15,45 @@ view:
   carries summaries, not raw buckets — the weighted estimate is exact
   for count/sum/min/max and a documented approximation for p50/p95/p99).
 
-Two input paths share the merge:
+Three input paths share the merge:
 
 - **in-process** (``FleetRouter.metrics_snapshot``): per-shard
   engine-local registries, with the process-global registry layered in
   once, un-summed — global families are shared by every shard, so
   summing them would multiply by N;
 - **file scrape** (:func:`read_snapshot_dir`): a directory of per-shard
-  snapshot JSON files, one process each — the mode the future
-  multi-process fleet reuses verbatim, and what ``ytpu_top <dir>`` and
-  ``ytpu_stats --merge`` consume.
+  snapshot JSON files, one process each — what ``ytpu_top <dir>`` and
+  ``ytpu_stats --merge`` consume, and the supervisor's fallback when
+  the admin plane is disabled;
+- **HTTP scrape** (:func:`scrape_endpoints`, ISSUE 16): GET each
+  process's ``/metrics.json`` admin endpoint — the mode multi-host
+  clusters use, since remote shards share no filesystem.
+
+Both scrape paths are hardened against mid-write / mid-death races: a
+file deleted between listdir and open, a truncated JSON body, or an
+endpoint closing the socket mid-response all yield a **stale-marked
+empty source** (counted in ``ytpu_fed_scrape_errors_total{mode}``),
+never an exception — a dying shard renders a blank row, it does not
+take the dashboard down with it.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
+import threading
+import urllib.parse
+import urllib.request
 from typing import Iterable, Optional
 
 __all__ = [
     "federate_snapshots",
     "read_snapshot_dir",
+    "scrape_endpoints",
     "merge_summaries",
     "FederationMetrics",
+    "fed_metrics",
 ]
 
 
@@ -133,6 +149,9 @@ def federate_snapshots(sources: list[dict],
         "federation": {
             "sources": len(sources),
             "roles": roles,
+            "stale": sorted(
+                str(s.get("label", "?")) for s in sources if s.get("stale")
+            ),
         },
     }
 
@@ -140,9 +159,11 @@ def federate_snapshots(sources: list[dict],
 def read_snapshot_dir(path: str) -> list[dict]:
     """Load every ``*.json`` metrics snapshot in a directory as a
     federation source (label = file stem, role from the snapshot's own
-    ``role`` key when present).  Unreadable files contribute an empty
-    snapshot — a mid-write scrape renders a blank row, never crashes
-    the dashboard."""
+    ``role`` key when present).  Unreadable files — deleted between
+    listdir and open, or caught mid-write — contribute a stale-marked
+    empty snapshot and count in
+    ``ytpu_fed_scrape_errors_total{mode="file"}``: a dying shard
+    renders a blank row, never crashes the dashboard."""
     sources = []
     try:
         names = sorted(
@@ -153,17 +174,81 @@ def read_snapshot_dir(path: str) -> list[dict]:
     for n in names:
         label = n[: -len(".json")]
         snap: dict = {}
+        stale = False
         try:
             with open(os.path.join(path, n)) as f:
                 snap = json.load(f)
         except (OSError, ValueError):
             snap = {}
+            stale = True
         if not isinstance(snap, dict):
             snap = {}
+            stale = True
+        if stale:
+            fed_metrics().scrape_error("file")
         sources.append({
             "label": label,
             "role": str(snap.get("role", "") or ""),
             "snapshot": snap,
+            "stale": stale,
+        })
+    return sources
+
+
+def _endpoint_label(url: str) -> str:
+    """A stable source label for one admin endpoint: host:port of the
+    URL (the snapshot's own ``label`` key wins when present)."""
+    try:
+        parts = urllib.parse.urlsplit(url)
+        return parts.netloc or url
+    except ValueError:
+        return url
+
+
+def scrape_endpoints(
+    urls: Iterable[str], timeout_s: float = 2.0
+) -> list[dict]:
+    """GET each admin endpoint's ``/metrics.json`` as a federation
+    source (ISSUE 16 HTTP scrape mode).
+
+    Each target gets its own ``timeout_s`` budget; a dead, hung, or
+    mid-death endpoint (refused connection, timeout, socket closed
+    mid-body, torn JSON) yields a **stale-marked empty source** and a
+    ``ytpu_fed_scrape_errors_total{mode="http"}`` increment — partial
+    failure is a rendering state, never a federation error.  ``urls``
+    may be bare ``host:port``, a base URL, or a full ``…/metrics.json``
+    path."""
+    sources = []
+    for url in urls:
+        u = str(url).rstrip("/")
+        if "://" not in u:
+            u = "http://" + u
+        if not u.endswith("/metrics.json"):
+            u = u + "/metrics.json"
+        snap: dict = {}
+        stale = False
+        try:
+            with urllib.request.urlopen(u, timeout=timeout_s) as resp:
+                body = resp.read()
+            snap = json.loads(body.decode("utf-8"))
+        except (OSError, ValueError, http.client.HTTPException):
+            # URLError subclasses OSError (refused/timeout/reset);
+            # a socket closed mid-body with a Content-Length promised
+            # surfaces as http.client.IncompleteRead
+            snap = {}
+            stale = True
+        if not isinstance(snap, dict):
+            snap = {}
+            stale = True
+        if stale:
+            fed_metrics().scrape_error("http")
+        label = snap.get("label") or _endpoint_label(u)
+        sources.append({
+            "label": str(label),
+            "role": str(snap.get("role", "") or ""),
+            "snapshot": snap,
+            "stale": stale,
+            "url": str(url),
         })
     return sources
 
@@ -186,7 +271,32 @@ class FederationMetrics:
             "Federated metric merges performed (fleet snapshots + file "
             "scrapes)",
         )
+        self.scrape_errors = registry.counter(
+            "ytpu_fed_scrape_errors_total",
+            "Federation sources skipped as stale (unreadable snapshot "
+            "file, or an admin endpoint that died mid-scrape), by "
+            "scrape mode",
+            labelnames=("mode",),
+        )
 
     def observe(self, n_sources: int) -> None:
         self.sources.set(int(n_sources))
         self.merges.inc()
+
+    def scrape_error(self, mode: str) -> None:
+        self.scrape_errors.labels(mode=mode).inc()
+
+
+_FED_METRICS: Optional[FederationMetrics] = None
+_FED_LOCK = threading.Lock()
+
+
+def fed_metrics() -> FederationMetrics:
+    """Process-wide :class:`FederationMetrics` singleton — the module
+    scrape functions have no registry handle of their own."""
+    # cold path (one call per scrape pass): plain lock, like rpc_metrics
+    global _FED_METRICS
+    with _FED_LOCK:
+        if _FED_METRICS is None:
+            _FED_METRICS = FederationMetrics()
+        return _FED_METRICS

@@ -24,6 +24,7 @@ from .lib0.decoding import Decoder
 from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
 from .obs import dist as obs_dist
+from .obs.admin import maybe_start_admin
 from .obs.slo import ConvergenceTracker
 from .ops.engine import BatchEngine
 from .persistence import (
@@ -352,6 +353,15 @@ class TpuProvider:
         # adaptive flush tick (ISSUE 12): paces flush_tick() callers by
         # SLO burn verdict + brownout level; explicit flush() ignores it
         self.flush_ticks = FlushTickController(r)
+        # mid-recovery flag the admin plane's /readyz keys off (ISSUE
+        # 16): recover() raises it around the WAL replay
+        self.recovering = False
+        # per-process HTTP introspection plane (ISSUE 16): opt-in for
+        # library-constructed providers — serves only when
+        # YTPU_ADMIN_PORT is set, so tests building hundreds of
+        # providers open zero sockets.  Cluster processes embed their
+        # own AdminServer around the whole shard/gateway instead.
+        self.admin = maybe_start_admin(self, "provider")
 
     # -- doc management -----------------------------------------------------
 
@@ -1288,6 +1298,86 @@ class TpuProvider:
         ok/warning/page verdict (see :class:`yjs_tpu.obs.slo.ConvergenceTracker`)."""
         return self.slo.snapshot()
 
+    # -- admin-plane surface (ISSUE 16) -------------------------------------
+
+    def residue_fraction(self) -> float | None:
+        """Fraction of last-flush planned structs handed to the
+        sequential YATA conflict fallback (``None`` before the first
+        flush with planner work) — the ROADMAP's top hot-spot number."""
+        m = self.engine.last_flush_metrics or {}
+        planned = (
+            m.get("plan_segment_fast", 0) + m.get("plan_segment_residue", 0)
+        )
+        if not planned:
+            return None
+        return m.get("plan_segment_residue", 0) / planned
+
+    def statusz(self) -> dict:
+        """The one-page JSON status the admin plane serves at
+        ``/statusz``: identity, occupancy across tiers, session table,
+        SLO verdict, brownout level, plan-cache hit rate, and the
+        segment-residue fraction."""
+        from .obs import global_registry
+
+        reg = global_registry()
+
+        def _val(name):
+            return getattr(reg.get(name), "value", 0)
+
+        hits = _val("ytpu_plan_cache_hits_total")
+        probes = hits + _val("ytpu_plan_cache_misses_total")
+        adm = self.admission.snapshot()
+        rec = self.last_recovery or {}
+        frac = self.residue_fraction()
+        return {
+            "role": "provider" if self.shard_id is None else "shard",
+            "shard": self.shard_id,
+            "docs": len(self._guids),
+            "capacity": self.engine.n_docs,
+            "occupancy": round(self.occupancy, 4),
+            "resident_docs": self.resident_docs,
+            "fallback_docs": self.n_fallback_docs,
+            "tiers": self.tier_snapshot(),
+            "sessions": self.sessions_snapshot(),
+            "slo": self.slo_snapshot(),
+            "health": self.health(),
+            "admission": {
+                "level": adm["level"],
+                "level_name": adm["level_name"],
+                "queue_depth": adm["queue_depth"],
+            },
+            "plan_cache_hit_rate": (
+                round(hits / probes, 4) if probes else None
+            ),
+            "residue_fraction": (
+                None if frac is None else round(frac, 4)
+            ),
+            "recovering": self.recovering,
+            "recovered_records": rec.get("records_applied", 0),
+        }
+
+    def readiness(self) -> dict:
+        """The ``/readyz`` verdict: ready iff recovery is complete and
+        the brownout ladder sits below reject-writes.  Reads only plain
+        attributes — a readiness probe must never contend on engine
+        locks (liveness is ``/healthz``'s job; this answers "should you
+        route traffic here")."""
+        level = self.admission.brownout.level
+        ready = (not self.recovering) and level < 3
+        return {
+            "ready": ready,
+            "checks": {
+                "recovery_complete": not self.recovering,
+                "brownout_level": level,
+                "accepting_writes": level < 3,
+            },
+        }
+
+    def trace_events(self) -> list[dict]:
+        """Bounded recent-span dump for the admin plane's
+        ``/debug/trace``."""
+        return self.engine.obs.tracer.trace_events()
+
     # -- tiering surface (ISSUE 7) ------------------------------------------
 
     def demote_doc(self, guid: str, tier: str = "warm") -> bool:
@@ -1412,6 +1502,8 @@ class TpuProvider:
             if checkpoint:
                 self.checkpoint()
             self.wal.close()
+        if self.admin is not None:
+            self.admin.close()
 
     def release_doc(self, guid: str) -> bytes:
         """Evict a room and free its engine slot for reuse (the typed
@@ -1579,9 +1671,13 @@ class TpuProvider:
             tier_config=tier_config,
             admission_config=admission_config,
         )
-        prov.last_recovery = replay_wal(
-            prov, path, exclude_from=prov.wal.first_index
-        )
+        prov.recovering = True
+        try:
+            prov.last_recovery = replay_wal(
+                prov, path, exclude_from=prov.wal.first_index
+            )
+        finally:
+            prov.recovering = False
         return prov
 
 
