@@ -2181,6 +2181,178 @@ def bench_cluster() -> dict:
     return out
 
 
+def bench_geo() -> dict:
+    """Cross-region convergence under injected WAN latency (ISSUE 17):
+    three regions in a full GeoReplicator mesh, each link delayed by a
+    seeded RTT distribution, and per-update convergence measured as
+    ticks from accepted-at-origin until visible in EVERY region.  The
+    whole mesh is tick-driven, so the numbers are deterministic —
+    latency comes from the injected delay plus the delta scheduler's
+    own batching, never from the host machine.
+
+    Reported per injected RTT {50, 150, 300} ms: convergence p50/p99
+    in ms, plus ``p99_over_floor`` — the p99 as a multiple of the
+    one-way propagation floor (rtt/2; the acceptance band is <= 5x at
+    150 ms).  A final leg severs one link at 150 ms RTT mid-edit and
+    reports the partition-heal catch-up time.
+
+    The block is also written to BENCH_geo.json.
+    """
+    import yjs_tpu as Y
+    from yjs_tpu.geo import GeoConfig, GeoReplicator
+    from yjs_tpu.provider import TpuProvider
+    from yjs_tpu.resilience import NetChaosConfig, NetworkFaultInjector
+    from yjs_tpu.sync.session import SessionConfig
+    from yjs_tpu.sync.transport import PipeNetwork
+
+    tick_ms = int(os.environ.get("YTPU_BENCH_GEO_TICK_MS", "5"))
+    n_edits = int(os.environ.get("YTPU_BENCH_GEO_EDITS", "40"))
+    regions = ("A", "B", "C")
+    rooms = ("room-0", "room-1", "room-2")
+    session_cfg = SessionConfig(
+        seed=7, heartbeat=0, liveness=0, antientropy=8,
+        hello_timeout=0, retry_base=4, retry_cap=16, retry_max=6,
+    )
+
+    def mk_update(token, client_id):
+        d = Y.Doc(gc=False)
+        d.client_id = client_id
+        d.get_text("text").insert(0, token)
+        return Y.encode_state_as_update(d)
+
+    def mk_mesh(rtt_ms, faults_off=False):
+        one_way_ticks = max(1, rtt_ms // 2 // tick_ms)
+        provs = {r: TpuProvider(8, backend="cpu") for r in regions}
+        reps = {
+            r: GeoReplicator(
+                provs[r],
+                GeoConfig(region=r, seed=11 + i, tick_ms=tick_ms),
+            )
+            for i, r in enumerate(regions)
+        }
+        nets = {}
+        for i, (x, y) in enumerate((("A", "B"), ("A", "C"), ("B", "C"))):
+            inj = None
+            if not faults_off:
+                inj = NetworkFaultInjector(NetChaosConfig(
+                    seed=97 + i, rtt_ticks=one_way_ticks,
+                    rtt_jitter_ticks=max(1, one_way_ticks // 4),
+                ))
+            net = PipeNetwork(inj)
+            nets[(x, y)] = net
+            tx, ty = net.pair(f"geo:{x}", f"geo:{y}")
+            reps[x].add_peer(y, (lambda t: (lambda: t))(tx),
+                             session_config=session_cfg)
+            reps[y].add_peer(x, (lambda t: (lambda: t))(ty),
+                             session_config=session_cfg)
+        return provs, reps, nets
+
+    def step(provs, reps, nets):
+        for p in provs.values():
+            p.flush()
+        for rep in reps.values():
+            rep.tick()
+        for net in nets.values():
+            net.pump()
+
+    def visible_everywhere(provs, room, token):
+        return all(
+            room in p.guids() and token in p.text(room)
+            for p in provs.values()
+        )
+
+    def pct(samples, p):
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    def run_rtt(rtt_ms):
+        provs, reps, nets = mk_mesh(rtt_ms)
+        for _ in range(60):  # handshakes settle
+            step(provs, reps, nets)
+        lat_ticks = []
+        for n in range(n_edits):
+            origin = regions[n % len(regions)]
+            room = rooms[n % len(rooms)]
+            token = f"[{origin}{n}]"
+            provs[origin].receive_update(
+                room, mk_update(token, 1000 + n)
+            )
+            ticks = 0
+            while not visible_everywhere(provs, room, token):
+                step(provs, reps, nets)
+                ticks += 1
+                if ticks > 4000:
+                    raise RuntimeError(f"{token} never converged")
+            lat_ticks.append(ticks)
+        floor_ms = max(1, rtt_ms // 2)
+        p50 = pct(lat_ticks, 0.50) * tick_ms
+        p99 = pct(lat_ticks, 0.99) * tick_ms
+        return {
+            "rtt_ms": rtt_ms,
+            "one_way_ticks": max(1, rtt_ms // 2 // tick_ms),
+            "n_updates": len(lat_ticks),
+            "p50_ms": p50,
+            "p99_ms": p99,
+            "floor_ms": floor_ms,
+            "p50_over_floor": round(p50 / floor_ms, 2),
+            "p99_over_floor": round(p99 / floor_ms, 2),
+        }
+
+    def run_heal(rtt_ms):
+        """Sever A<->B mid-edit, keep editing through the outage, then
+        restore the link and count ticks until full convergence."""
+        provs, reps, nets = mk_mesh(rtt_ms)
+        for _ in range(60):
+            step(provs, reps, nets)
+        net_ab = nets[("A", "B")]
+        good_inj = net_ab.injector
+        net_ab.injector = NetworkFaultInjector(
+            NetChaosConfig(seed=5, drop=1.0)
+        )
+        outage_ticks = 120
+        for n in range(outage_ticks):
+            if n % 4 == 0:
+                origin = regions[n % len(regions)]
+                provs[origin].receive_update(
+                    f"room-{n % 3}", mk_update(f"[o{n}]", 5000 + n)
+                )
+            step(provs, reps, nets)
+        net_ab.injector = good_inj
+        ticks = 0
+        while True:
+            done = all(
+                provs["A"].text(room) == provs["B"].text(room)
+                == provs["C"].text(room)
+                for room in rooms
+                if any(room in p.guids() for p in provs.values())
+            )
+            if done:
+                break
+            step(provs, reps, nets)
+            ticks += 1
+            if ticks > 6000:
+                raise RuntimeError("mesh never healed")
+        return {
+            "rtt_ms": rtt_ms,
+            "outage_ms": outage_ticks * tick_ms,
+            "catchup_ms": ticks * tick_ms,
+        }
+
+    out = {
+        "tick_ms": tick_ms,
+        "n_edits": n_edits,
+    }
+    for rtt_ms in (50, 150, 300):
+        out[f"rtt_ms_{rtt_ms}"] = run_rtt(rtt_ms)
+    out["heal"] = run_heal(150)
+    try:
+        with open("BENCH_geo.json", "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass  # artifact only; the inline detail block is authoritative
+    return out
+
+
 def main():
     n_docs_b4 = int(os.environ.get("YTPU_BENCH_DOCS", "16384"))
     # 1024 when the pre-generated fixture exists (the r2-verdict shape);
@@ -2248,6 +2420,8 @@ def main():
     overload = bench_overload()
     time.sleep(3)
     cluster = bench_cluster()
+    time.sleep(3)
+    geo = bench_geo()
     time.sleep(3)
     obs_prof = bench_obs_prof()
     try:
@@ -2329,6 +2503,7 @@ def main():
             "failover": failover,
             "overload": overload,
             "cluster": cluster,
+            "geo": geo,
         },
     }
     if sweep is not None:

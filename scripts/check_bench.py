@@ -12,6 +12,8 @@ Guarded metrics (direction-aware: a *better* number never fails):
     BENCH_flush.json     overlap_fraction        higher is better
     BENCH_cluster.json   process.converge_ms_p50 lower is better
     BENCH_overload.json  shed_fraction           higher is better
+    BENCH_geo.json       rtt_ms_150.p99_over_floor  lower is better
+    BENCH_geo.json       heal.catchup_ms         lower is better
 
 Modes:
 
@@ -61,6 +63,13 @@ METRICS = (
      ("process", "converge_ms_p50"), "lower", 1.00),
     ("overload.shed_fraction", "BENCH_overload.json",
      ("shed_fraction",), "higher", 0.10),
+    # the geo mesh is fully tick-driven, so these are deterministic on
+    # a given seed — the band only absorbs scheduler-tweak drift, not
+    # host noise (ISSUE 17 acceptance: p99 <= 5x the RTT floor)
+    ("geo.converge_p99_x_floor", "BENCH_geo.json",
+     ("rtt_ms_150", "p99_over_floor"), "lower", 1.00),
+    ("geo.heal_catchup_ms", "BENCH_geo.json",
+     ("heal", "catchup_ms"), "lower", 1.00),
 )
 
 
@@ -129,6 +138,7 @@ def run_benchmarks(out_dir: Path) -> None:
         bench.bench_flush()
         bench.bench_overload()
         bench.bench_cluster()
+        bench.bench_geo()
     finally:
         os.chdir(cwd)
 

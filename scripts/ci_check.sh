@@ -29,6 +29,13 @@ if [[ "${YTPU_CI_BENCH:-0}" == "1" ]]; then
     python scripts/check_bench.py
 fi
 
+echo "== geo replication smoke (marker: geo) =="
+# the multi-region active-active suite (ISSUE 17) is the newest
+# subsystem: doc-space codecs, the budgeted WAN delta scheduler,
+# one-way-partition/flap chaos convergence, and journaled-floor
+# resume-after-kill regressions surface fast and isolated
+python -m pytest tests/ -q -m 'geo and not slow' -p no:cacheprovider
+
 echo "== admin plane smoke (marker: admin) =="
 # the per-process introspection plane (ISSUE 16): endpoint unit tests,
 # readiness/fencing semantics, scrape-race hardening, and the

@@ -111,6 +111,25 @@ SESSION_COLUMNS = (
     ("shed", 5),
 )
 
+# per-link geo rows (rendered when a snapshot carries a "geo" block —
+# see GeoReplicator.snapshot, surfaced by provider.metrics_snapshot and
+# the fleet/cluster statusz pages)
+GEO_COLUMNS = (
+    ("provider", 14),
+    ("region", 8),
+    ("link", 8),
+    ("state", 12),
+    ("health", 8),
+    ("outbox", 7),
+    ("dirty", 6),
+    ("lag B", 7),
+    ("lag s", 7),
+    ("reconn", 7),
+    ("resume", 7),
+    ("resync", 7),
+    ("dl", 4),
+)
+
 _STATE_NAMES = {0: "ok", 1: "warning", 2: "page"}
 
 # brownout degradation levels, abbreviated to fit the column
@@ -256,6 +275,33 @@ def collect_row(
             if snap.get("fleet")
             else None
         ),
+        "geo": [
+            {
+                "provider": name,
+                "region": str((snap.get("geo") or {}).get("region", "?")),
+                "link": str(ln.get("link", "?")),
+                "state": str(ln.get("state", "?")),
+                "health": str(ln.get("detector", "?")),
+                "outbox": int(ln.get("outbox", 0)),
+                "dirty": int(ln.get("dirty_docs", 0)),
+                "lag B": int(ln.get("lag_bytes", 0)),
+                "lag s": f"{float(ln.get('lag_seconds', 0)):.1f}",
+                "reconn": int(ln.get("reconnects", 0)),
+                "resume": int(ln.get("resumes", 0)),
+                "resync": int(ln.get("full_resyncs", 0)),
+                "dl": int(ln.get("dead_letters", 0)),
+            }
+            for ln in (snap.get("geo") or {}).get("links", [])
+        ],
+        "geo_head": (
+            {
+                "region": str(snap["geo"].get("region", "?")),
+                "epoch": int(snap["geo"].get("epoch", 0)),
+                "links": len(snap["geo"].get("links", [])),
+            }
+            if snap.get("geo")
+            else None
+        ),
         "totals": {"docs_flushed": docs_flushed},
     }
 
@@ -297,6 +343,27 @@ def render(rows: list[dict], interval: float) -> str:
             out.append(
                 "  ".join(
                     f"{str(s[title]):>{w}}" for title, w in FLEET_COLUMNS
+                )
+            )
+    geo_rows = [g for row in rows for g in row.get("geo", [])]
+    if geo_rows:
+        heads = [r["geo_head"] for r in rows if r.get("geo_head")]
+        out.append("")
+        if heads:
+            out.append(
+                "geo: " + "  ".join(
+                    f"region={h['region']} epoch={h['epoch']} "
+                    f"links={h['links']}"
+                    for h in heads
+                )
+            )
+        out.append(
+            "  ".join(f"{title:>{w}}" for title, w in GEO_COLUMNS)
+        )
+        for g in geo_rows:
+            out.append(
+                "  ".join(
+                    f"{str(g[title]):>{w}}" for title, w in GEO_COLUMNS
                 )
             )
     sess_rows = [s for row in rows for s in row.get("sessions", [])]

@@ -158,6 +158,15 @@ def pytest_configure(config):
         "admin: HTTP admin endpoints, health probes, scrape "
         "federation, and bench-gate tests",
     )
+    # "geo" tags the multi-region active-active replication suite
+    # (ISSUE 17) — in tier-1 by default (in-memory pipes, seeded WAN
+    # chaos, tmp-dir WALs), deselectable with -m 'not geo'; ci_check.sh
+    # also runs it standalone first
+    config.addinivalue_line(
+        "markers",
+        "geo: multi-region replication, WAN chaos convergence, and "
+        "partition-recovery tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

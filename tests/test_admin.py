@@ -539,7 +539,8 @@ def test_concurrent_scrape_hammer_against_flushing_provider():
 # -- bench-regression gate ----------------------------------------------------
 
 
-def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86):
+def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86,
+                     geo_p99=1.27, geo_heal=105.0):
     (d / "BENCH_planner.json").write_text(
         json.dumps({"cold_vs_warm_ratio": planner})
     )
@@ -551,6 +552,12 @@ def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86):
     )
     (d / "BENCH_overload.json").write_text(
         json.dumps({"shed_fraction": shed})
+    )
+    (d / "BENCH_geo.json").write_text(
+        json.dumps({
+            "rtt_ms_150": {"p99_over_floor": geo_p99},
+            "heal": {"catchup_ms": geo_heal},
+        })
     )
 
 
@@ -578,13 +585,15 @@ def test_check_bench_tolerance_bands(tmp_path):
     )
 
     # better in the metric's own direction never fails
-    _write_baselines(fresh, planner=1.0, overlap=0.99, p50=1.0, shed=0.99)
+    _write_baselines(fresh, planner=1.0, overlap=0.99, p50=1.0, shed=0.99,
+                     geo_p99=1.0, geo_heal=50.0)
     assert all(
         v["status"] == "ok" for v in compare(fresh, base, {})
     )
 
     # each metric regressed past its band fails, direction-aware
-    _write_baselines(fresh, planner=99.0, overlap=0.1, p50=99.0, shed=0.1)
+    _write_baselines(fresh, planner=99.0, overlap=0.1, p50=99.0, shed=0.1,
+                     geo_p99=99.0, geo_heal=9999.0)
     verdicts = compare(fresh, base, {})
     assert all(v["status"] == "regression" for v in verdicts)
 
@@ -592,6 +601,7 @@ def test_check_bench_tolerance_bands(tmp_path):
     _write_baselines(
         fresh, planner=2.0 * 1.3, overlap=0.85 * 0.9,
         p50=2.5 * 1.5, shed=0.86 * 0.95,
+        geo_p99=1.27 * 1.5, geo_heal=105.0 * 1.8,
     )
     assert all(v["status"] == "ok" for v in compare(fresh, base, {}))
 

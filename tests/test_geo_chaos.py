@@ -1,0 +1,306 @@
+"""Geo WAN-chaos property suite (ISSUE 17): three regions — each a
+full single-site provider — joined active-active over per-link
+GeoReplicators, every WAN link independently faulted with the full
+profile (drop / duplicate / delay / reorder / symmetric partition /
+one-way partition / deterministic flapping), edits streaming WHILE the
+faults fire.  The contract under any mix and any seed:
+
+- every region ends byte-identical per room (text + state vector);
+- zero acked-update loss: every update a region's ingress accepted
+  appears in every region's converged state;
+- nobody falls back to a full resync after the initial handshake
+  (``full_resyncs == 1`` per link), and after a region is kill -9'd
+  and recovered from its WAL the surviving links RESUME from the
+  journaled ack floor (``resumes >= 1``, ``full_resyncs`` still 1).
+
+Everything is tick-driven and seeded — a failure replays exactly.  The
+``geo`` marker deselects the suite with ``-m 'not geo'``.
+"""
+
+import random
+
+import pytest
+
+import yjs_tpu as Y
+from yjs_tpu.geo import GeoConfig, GeoReplicator
+from yjs_tpu.provider import TpuProvider
+from yjs_tpu.resilience import NetChaosConfig, NetworkFaultInjector
+from yjs_tpu.sync.session import SessionConfig
+from yjs_tpu.sync.transport import PipeNetwork
+from yjs_tpu.updates import encode_state_as_update
+
+pytestmark = [pytest.mark.geo, pytest.mark.chaos]
+
+CORPUS_SEEDS = tuple(range(20))
+
+# the full WAN storm: every classic fault plus the geo-profile faults
+# (asymmetric one-way partitions and deterministic link flapping)
+WAN_STORM = dict(
+    drop=0.15, duplicate=0.15, delay=0.2, reorder=0.25, partition=0.03,
+    oneway=0.03, flap_ticks=11,
+)
+
+# retransmission must out-run the worst fault window (flap-down is
+# flap_ticks rounds long), and anti-entropy must close any retry-cap
+# hole well inside the round budget
+GEO_SESSION = dict(
+    retry_base=4, retry_cap=16, retry_max=6, retry_jitter=0.25,
+    antientropy=8, heartbeat=0, liveness=0, hello_timeout=0,
+)
+
+REGIONS = ("A", "B", "C")
+ROOMS = ("room-0", "room-1", "room-2")
+
+
+def _mk_update(token: str, client_id: int) -> bytes:
+    d = Y.Doc(gc=False)
+    d.client_id = client_id
+    d.get_text("text").insert(0, token)
+    return encode_state_as_update(d)
+
+
+class GeoMesh:
+    """Three regions in a full WAN mesh, each link its own faulted
+    PipeNetwork; tracks every accepted token for the acked-loss
+    oracle."""
+
+    PAIRS = (("A", "B"), ("A", "C"), ("B", "C"))
+
+    def __init__(self, seed: int, faults: dict, wal_dirs=None):
+        self.seed = seed
+        self.session_cfg = SessionConfig(seed=seed, **GEO_SESSION)
+        self.provs: dict[str, TpuProvider] = {}
+        self.reps: dict[str, GeoReplicator] = {}
+        self.nets: dict[tuple[str, str], PipeNetwork] = {}
+        # (src, dst) -> {"t": transport | None}; links reconnect
+        # through these, so tests heal a WAN cut by swapping the holder
+        self.holders: dict[tuple[str, str], dict] = {}
+        self.accepted: dict[str, set] = {r: set() for r in ROOMS}
+        self._gen = random.Random(seed)
+        self._n_edits = 0
+        for i, r in enumerate(REGIONS):
+            wal = None if wal_dirs is None else str(wal_dirs[r])
+            self.provs[r] = TpuProvider(8, backend="cpu", wal_dir=wal)
+            self.reps[r] = GeoReplicator(
+                self.provs[r],
+                GeoConfig(region=r, seed=seed * 7 + i,
+                          reconnect_cap=8),
+            )
+        for i, (x, y) in enumerate(self.PAIRS):
+            inj = (
+                NetworkFaultInjector(NetChaosConfig(
+                    seed=(seed * 31 + i) & 0x7FFFFFFF, **faults,
+                ))
+                if faults
+                else None
+            )
+            self.nets[(x, y)] = PipeNetwork(inj)
+            self.connect(x, y)
+
+    def connect(self, x: str, y: str) -> None:
+        tx, ty = self.nets[(x, y)].pair(f"geo:{x}", f"geo:{y}")
+        hx = self.holders.setdefault((x, y), {"t": None})
+        hy = self.holders.setdefault((y, x), {"t": None})
+        hx["t"], hy["t"] = tx, ty
+        for region, peer, h in ((x, y, hx), (y, x, hy)):
+            if peer not in self.reps[region].links:
+                self.reps[region].add_peer(
+                    peer, (lambda hh: (lambda: hh["t"]))(h),
+                    session_config=self.session_cfg,
+                )
+
+    def maybe_edit(self, region: str) -> None:
+        if self._gen.random() >= 0.3:
+            return
+        self._n_edits += 1
+        token = f"[{region}{self._n_edits}]"
+        room = ROOMS[self._gen.randrange(len(ROOMS))]
+        client = 1000 * (REGIONS.index(region) + 1) + self._n_edits
+        if self.provs[region].receive_update(
+            room, _mk_update(token, client)
+        ):
+            # the ingress ACCEPTED this update: it may never be lost
+            self.accepted[room].add(token)
+
+    def step(self, editing: bool = False) -> None:
+        for r in REGIONS:
+            if editing:
+                self.maybe_edit(r)
+        for p in self.provs.values():
+            p.flush()
+        for rep in self.reps.values():
+            rep.tick()
+        for net in self.nets.values():
+            net.pump()
+
+    def converged(self) -> bool:
+        for room in ROOMS:
+            texts = set()
+            svs = []
+            for p in self.provs.values():
+                texts.add(p.text(room) if room in p.guids() else "")
+                svs.append(
+                    p.state_vector(room) if room in p.guids() else {}
+                )
+            if len(texts) != 1:
+                return False
+            if any(sv != svs[0] for sv in svs[1:]):
+                return False
+        return True
+
+    def all_live(self) -> bool:
+        """Every geo link finished its handshake.  Convergence alone is
+        not stability: texts can agree transitively (A<->C, C<->B)
+        while one link is still in backoff — and the backoff rng is
+        sid-keyed, so how long that takes depends on how many sessions
+        the process created before this test."""
+        return all(
+            link.session.state == "live"
+            for rep in self.reps.values()
+            for link in rep.links.values()
+        )
+
+    def run(self, edit_rounds=50, max_rounds=2500, quiet=12) -> int:
+        stable = 0
+        for n in range(max_rounds):
+            self.step(editing=n < edit_rounds)
+            if n >= edit_rounds:
+                if self.converged() and self.all_live():
+                    stable += 1
+                    if stable >= quiet:
+                        return n
+                else:
+                    stable = 0
+        return max_rounds
+
+    def assert_identical_and_lossless(self) -> None:
+        for room in ROOMS:
+            texts = {
+                p.text(room) if room in p.guids() else ""
+                for p in self.provs.values()
+            }
+            assert len(texts) == 1, f"{room} diverged: {texts}"
+            final = next(iter(texts))
+            missing = [
+                t for t in self.accepted[room] if t not in final
+            ]
+            assert not missing, (
+                f"acked updates lost in {room}: {missing}"
+            )
+
+    def assert_no_full_resyncs(self) -> None:
+        for r, rep in self.reps.items():
+            for peer, link in rep.links.items():
+                s = link.session
+                assert s.n_full_resyncs == 1, (r, peer, s.snapshot())
+                assert s.n_resumes == 0, (r, peer, s.snapshot())
+
+
+@pytest.mark.parametrize("seed", CORPUS_SEEDS)
+def test_three_region_wan_storm_converges(seed):
+    mesh = GeoMesh(seed, WAN_STORM)
+    rounds = mesh.run()
+    assert rounds < 2500, "geo mesh never reached a stable fixpoint"
+    mesh.assert_identical_and_lossless()
+    mesh.assert_no_full_resyncs()
+
+
+def test_clean_geo_mesh_has_no_recovery_traffic():
+    mesh = GeoMesh(5, {})
+    mesh.run(edit_rounds=40, max_rounds=800)
+    mesh.assert_identical_and_lossless()
+    mesh.assert_no_full_resyncs()
+    for rep in mesh.reps.values():
+        for link in rep.links.values():
+            assert link.n_dead_letters == 0
+            assert link.session.n_retransmits == 0
+
+
+def test_region_kill9_recovers_and_resumes(tmp_path):
+    """The ISSUE 17 acceptance: kill -9 one region mid-storm under the
+    full WAN fault mix, recover it from its journaled WAL, heal the
+    partition — byte-identical convergence, zero acked loss, and the
+    surviving regions RESUME their links from the journaled ack floor
+    instead of full-resyncing (``full_resyncs`` stays 1 per link,
+    ``resumes >= 1`` toward the recovered region)."""
+    seed = 11
+    wal_dirs = {r: tmp_path / r for r in REGIONS}
+    mesh = GeoMesh(seed, WAN_STORM, wal_dirs=wal_dirs)
+    # storm phase: edits stream while every link is faulted
+    for n in range(60):
+        mesh.step(editing=True)
+    # settle enough that A has acked SOMETHING from each peer — the
+    # journaled recv floors are what arm the resume hints after
+    # recovery — without requiring convergence
+    for n in range(400):
+        mesh.step()
+        if all(
+            mesh.reps["A"].links[p].floor["seq"] >= 1
+            for p in ("B", "C")
+        ):
+            break
+    assert all(
+        mesh.reps["A"].links[p].floor["seq"] >= 1 for p in ("B", "C")
+    ), "storm never let A ack anything; no floor to resume from"
+    old_epoch = mesh.reps["A"].epoch
+
+    # kill -9: region A vanishes — no close, no checkpoint; its WAN
+    # transports die with the process and the survivors' connect_fn
+    # holders go empty (the WAN route to A is down)
+    for x, y in (("A", "B"), ("A", "C")):
+        net = mesh.nets[(x, y)]
+        ha, hs = mesh.holders[(x, y)], mesh.holders[(y, x)]
+        net.kill(*(h["t"] for h in (ha, hs) if h["t"] is not None))
+        ha["t"] = hs["t"] = None
+    del mesh.provs["A"], mesh.reps["A"]
+
+    # the survivors keep editing into the outage; their A-links sit in
+    # reconnect backoff against the empty holders
+    for n in range(40):
+        mesh.maybe_edit("B")
+        mesh.maybe_edit("C")
+        for r in ("B", "C"):
+            mesh.provs[r].flush()
+            mesh.reps[r].tick()
+        for net in mesh.nets.values():
+            net.pump()
+    for r in ("B", "C"):
+        assert mesh.reps[r].links["A"].session.state == "reconnecting"
+        assert mesh.reps[r].detector.state_of("A") in ("suspect", "dead")
+
+    # recover A from its WAL: journaled KIND_GEO floors arm resume
+    # hints, and the new fencing epoch is past every journaled one
+    pa = TpuProvider.recover(str(wal_dirs["A"]), backend="cpu")
+    assert pa.last_recovery["geo_links"] >= 1
+    ra = GeoReplicator(
+        pa, GeoConfig(region="A", seed=seed * 7, reconnect_cap=8),
+    )
+    assert ra.epoch > old_epoch
+    mesh.provs["A"] = pa
+    mesh.reps["A"] = ra
+    survivors_before = {
+        r: {
+            "resumes": mesh.reps[r].links["A"].session.n_resumes,
+            "resyncs": mesh.reps[r].links["A"].session.n_full_resyncs,
+        }
+        for r in ("B", "C")
+    }
+    # heal the WAN: fresh faulted pipes land in the connect_fn holders;
+    # the recovered replicator arms resume hints from the journaled
+    # floors and the survivors' links pick the route up from backoff
+    mesh.connect("A", "B")
+    mesh.connect("A", "C")
+
+    rounds = mesh.run(edit_rounds=0)
+    assert rounds < 2500, "mesh never converged after recovery"
+    mesh.assert_identical_and_lossless()
+    for r in ("B", "C"):
+        s = mesh.reps[r].links["A"].session
+        before = survivors_before[r]
+        assert s.n_full_resyncs == before["resyncs"] == 1, (
+            r, s.snapshot(),
+        )
+        assert s.n_resumes == before["resumes"] + 1, (r, s.snapshot())
+    # B<->C never went down: still on their original handshake
+    assert mesh.reps["B"].links["C"].session.n_full_resyncs == 1
+    assert mesh.reps["C"].links["B"].session.n_full_resyncs == 1

@@ -42,7 +42,7 @@ RULE_METRIC = "metric-drift"
 KNOB_PREFIXES = (
     "CHAOS", "RESILIENCE", "DLQ", "WAL", "PROF", "SLO", "NET", "FLEET",
     "TIER", "REPL", "FAILOVER", "PLAN", "ADM", "ADMIN", "TRACE",
-    "BLACKBOX", "FLUSH", "LINT", "CLUSTER", "GATEWAY",
+    "BLACKBOX", "FLUSH", "LINT", "CLUSTER", "GATEWAY", "GEO",
 )
 
 KNOB_RE = re.compile(
@@ -313,6 +313,11 @@ def live_comparison(root) -> list:
 
     admin_metrics()
     fed_metrics()
+    # ... and the geo families (ISSUE 17): registered by the first
+    # GeoReplicator; instantiating the metrics holder is enough
+    from yjs_tpu.geo.replicator import GeoMetrics
+
+    GeoMetrics()
     live = set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

@@ -164,6 +164,10 @@ class Supervisor:
         )
         self.on_update = None  # callable(guid: str, update: bytes)
         self.on_epoch = None   # callable(epoch: int, shards: list[int])
+        # inter-region replication (ISSUE 17): a GeoReplicator attached
+        # via attach_geo is ticked by the monitor loop and fed routing-
+        # epoch bumps so WAN links rehome when a shard fails over
+        self.geo = None
         # the supervisor's own introspection plane (ISSUE 16): serves
         # the FEDERATED cluster view at /metrics.json, so one scrape of
         # the supervisor renders the whole cluster
@@ -530,6 +534,25 @@ class Supervisor:
                 except Exception:
                     pass  # a bad subscriber must not stall fan-out
 
+    # -- geo replication (ISSUE 17) ------------------------------------------
+
+    def attach_geo(self, replicator) -> None:
+        """Join this cluster into a geo mesh: the replicator (a
+        :class:`yjs_tpu.geo.GeoReplicator` built over this supervisor
+        facade) is driven from the monitor loop — one geo tick per
+        heartbeat interval — and fencing epochs follow routing-epoch
+        bumps via the replicator's own ``epoch`` poll."""
+        self.geo = replicator
+
+    def _geo_tick(self) -> None:
+        rep = self.geo
+        if rep is None:
+            return
+        try:
+            rep.tick()
+        except Exception:
+            pass  # a WAN-side fault must never stall shard supervision
+
     # -- supervision ---------------------------------------------------------
 
     def _monitor_loop(self) -> None:
@@ -545,6 +568,7 @@ class Supervisor:
         )
         next_probe: dict[int, float] = {}
         while not self._stop.wait(self.config.heartbeat_s):
+            self._geo_tick()
             if self.config.snapshot_dir and time.monotonic() >= next_snap:
                 next_snap = time.monotonic() + self.config.snapshot_s
                 try:
@@ -931,6 +955,7 @@ class Supervisor:
             "outcomes": report["outcomes"],
             "resolution": report["resolution"],
             "events": len(report["events"]),
+            "geo": None if self.geo is None else self.geo.snapshot(),
         }
 
     def readiness(self) -> dict:

@@ -1181,6 +1181,12 @@ class FleetRouter:
                 "level_name": adm["level_name"],
                 "queue_depth": adm["queue_depth"],
             },
+            # inter-region replication (ISSUE 17): present when a
+            # GeoReplicator is attached over this fleet facade
+            "geo": (
+                None if getattr(self, "geo", None) is None
+                else self.geo.snapshot()
+            ),
         }
 
     def readiness(self) -> dict:

@@ -1,0 +1,33 @@
+"""Geo-distributed active-active replication (ISSUE 17).
+
+See :mod:`yjs_tpu.geo.replicator` for the region driver and
+:mod:`yjs_tpu.geo.space` for the doc-space codecs and session host.
+"""
+
+from .replicator import (
+    GeoConfig,
+    GeoLink,
+    GeoMetrics,
+    GeoReplicator,
+    GeoSession,
+)
+from .space import (
+    SpaceSessionHost,
+    decode_space_sv,
+    decode_space_update,
+    encode_space_sv,
+    encode_space_update,
+)
+
+__all__ = [
+    "GeoConfig",
+    "GeoLink",
+    "GeoMetrics",
+    "GeoReplicator",
+    "GeoSession",
+    "SpaceSessionHost",
+    "decode_space_sv",
+    "decode_space_update",
+    "encode_space_sv",
+    "encode_space_update",
+]

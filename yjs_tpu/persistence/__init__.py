@@ -29,6 +29,7 @@ from .records import (
     KIND_ACK,
     KIND_ADM,
     KIND_DLQ,
+    KIND_GEO,
     KIND_MIGRATE,
     KIND_NAMES,
     KIND_RELEASE,
@@ -67,6 +68,7 @@ __all__ = [
     "KIND_ACK",
     "KIND_ADM",
     "KIND_DLQ",
+    "KIND_GEO",
     "KIND_MIGRATE",
     "KIND_NAMES",
     "KIND_RELEASE",

@@ -8,7 +8,7 @@ record format so a single reader serves segments and checkpoints alike:
     record header (14 B, little-endian):
         magic        u16    0x7EA1
         kind         u8     1=update 2=snapshot 3=dlq 4=release 5=ack
-                            6=migrate 7=tier 8=repl 9=adm
+                            6=migrate 7=tier 8=repl 9=adm 10=geo
         flags        u8     bit0 = payload uses the V2 update encoding
         guid_len     u16
         payload_len  u32
@@ -71,6 +71,15 @@ KIND_REPL = 8
 # "tick": controller_tick}.  Recovery surfaces a count and the last
 # level in its stats; the live level always restarts at "normal".
 KIND_ADM = 9
+# geo link state (ISSUE 17): journaled by a region's GeoReplicator when
+# an inter-region link's ack floor advances or its fencing epoch moves.
+# Guid is empty (link state is region-scoped, not doc-scoped); payload
+# is JSON {"peer": region, "sid": session_id, "seq": recv_floor,
+# "epoch": fencing_epoch}.  The LAST record per peer stands.  Recovery
+# surfaces the floors as resume hints so a region recovering from
+# kill -9 re-HELLOs its WAN links with the journaled floor and resumes
+# retransmission instead of full-resyncing every doc in the space.
+KIND_GEO = 10
 KIND_NAMES = {
     KIND_UPDATE: "update",
     KIND_SNAPSHOT: "snapshot",
@@ -81,6 +90,7 @@ KIND_NAMES = {
     KIND_TIER: "tier",
     KIND_REPL: "repl",
     KIND_ADM: "adm",
+    KIND_GEO: "geo",
 }
 
 FLAG_V2 = 1

@@ -27,6 +27,7 @@ from .records import (
     KIND_ACK,
     KIND_ADM,
     KIND_DLQ,
+    KIND_GEO,
     KIND_MIGRATE,
     KIND_RELEASE,
     KIND_REPL,
@@ -107,10 +108,11 @@ def count_guids(path, exclude_from: int | None = None) -> int:
     for j, p in enumerate(sources):
         for ev in iter_file_events(p, final=(j == len(sources) - 1)):
             if ev[0] == "record" and ev[1].kind not in (
-                KIND_DLQ, KIND_ADM
+                KIND_DLQ, KIND_ADM, KIND_GEO
             ):
-                # KIND_ADM records are fleet-scoped (empty guid) and
-                # must not inflate the recovered fleet size
+                # KIND_ADM/KIND_GEO records are fleet/region-scoped
+                # (empty guid) and must not inflate the recovered fleet
+                # size
                 guids.add(ev[1].guid)
     return len(guids)
 
@@ -150,6 +152,8 @@ def replay_wal(
         "repl_roles": {},
         "adm_transitions": 0,
         "adm_level": None,
+        "geo_links": 0,
+        "geo_floors": {},
         "tier_records": 0,
         "tier_placements": {},
         "corrupt_records": 0,
@@ -394,6 +398,34 @@ def replay_wal(
                     stats["adm_transitions"] += 1
                     stats["adm_level"] = str(info["level"])
                     m.replayed.labels(disposition="adm").inc()
+            elif rec.kind == KIND_GEO:
+                # geo link floor (ISSUE 17): "our WAN session with
+                # region <peer> holds <sid> up to <seq> at fencing
+                # epoch <epoch>".  The LAST record per peer stands;
+                # the rebuilt region's GeoReplicator HELLOs each link
+                # with these floors so a kill -9'd region RESUMES its
+                # WAN retransmission windows instead of full-resyncing
+                # the whole doc space across every link.
+                try:
+                    info = json.loads(rec.payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    info = None
+                hints = getattr(provider, "_recovered_geo", None)
+                if isinstance(info, dict) and hints is not None:
+                    try:
+                        floor = {
+                            "sid": int(info["sid"]),
+                            "seq": int(info["seq"]),
+                            "epoch": int(info.get("epoch", 0)),
+                        }
+                        peer = str(info["peer"])
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                    else:
+                        hints[peer] = floor
+                        stats["geo_floors"][peer] = floor
+                        stats["geo_links"] = len(hints)
+                        m.replayed.labels(disposition="geo").inc()
             elif rec.kind == KIND_ACK:
                 # session ack floor (ISSUE 5): the journaled "we hold
                 # peer session <sid> up to <seq>" fact.  Later records

@@ -31,6 +31,7 @@ from .persistence import (
     KIND_ACK,
     KIND_ADM,
     KIND_DLQ,
+    KIND_GEO,
     KIND_MIGRATE,
     KIND_RELEASE,
     KIND_REPL,
@@ -331,6 +332,11 @@ class TpuProvider:
         # (guid, peer) -> (peer sid, recv floor) journaled ack facts
         # collected by replay_wal; armed onto sessions as resume hints
         self._recovered_acks: dict[tuple[str, str], tuple[int, int]] = {}
+        # geo replication (ISSUE 17): region -> {"sid", "seq", "epoch"}
+        # link floors collected by replay_wal; the attached GeoReplicator
+        # (if any) arms them onto its WAN links as resume hints
+        self._recovered_geo: dict[str, dict] = {}
+        self.geo = None  # set by GeoReplicator.__init__ when attached
         # fleet membership (ISSUE 6): set by FleetRouter so admission
         # errors and dashboards name the shard, None standalone
         self.shard_id: int | None = None
@@ -1025,6 +1031,24 @@ class TpuProvider:
         ).encode("utf-8")
         self.wal.append(KIND_ACK, guid, payload)
 
+    def journal_geo_link(
+        self, peer: str, sid: int, seq: int, epoch: int
+    ) -> None:
+        """Journal a geo link floor (KIND_GEO): "our WAN session with
+        region ``peer`` holds ``sid`` up to ``seq`` at fencing epoch
+        ``epoch``".  Region-scoped (empty guid); the last record per
+        peer stands.  Recovery replays the floors into
+        ``_recovered_geo`` so a kill -9'd region's GeoReplicator
+        resumes its links instead of full-resyncing the doc space."""
+        if self.wal is None or not sid:
+            return
+        payload = json.dumps(
+            {"peer": str(peer), "sid": int(sid), "seq": int(seq),
+             "epoch": int(epoch)},
+            separators=(",", ":"),
+        ).encode("utf-8")
+        self.wal.append(KIND_GEO, "", payload)
+
     def journal_migration(self, guid: str, dst: int, epoch: int) -> None:
         """Journal a migration intent (KIND_MIGRATE): "room ``guid`` is
         moving to shard ``dst`` at routing epoch ``epoch``".  Written by
@@ -1116,6 +1140,20 @@ class TpuProvider:
                 {"peer": peer, "sid": sid, "seq": seq}
             ).encode("utf-8")
             self.wal.append(KIND_ACK, guid, payload)
+
+    def _journal_geo_floors(self) -> None:
+        """Re-append every known geo link floor (live links win over
+        recovered hints) after checkpoint compaction — same idiom as
+        :meth:`_journal_ack_floors`."""
+        if self.wal is None:
+            return
+        floors = dict(self._recovered_geo)
+        if self.geo is not None:
+            floors.update(self.geo.link_floors())
+        for peer, f in sorted(floors.items()):
+            self.journal_geo_link(
+                peer, f.get("sid", 0), f.get("seq", 0), f.get("epoch", 0)
+            )
 
     # -- state accessors ----------------------------------------------------
 
@@ -1291,6 +1329,8 @@ class TpuProvider:
         snap["sessions"] = self.sessions_snapshot()
         snap["tiers"] = tiers
         snap["admission"] = self.admission.snapshot()
+        if self.geo is not None:
+            snap["geo"] = self.geo.snapshot()
         return snap
 
     def slo_snapshot(self) -> dict:
@@ -1354,6 +1394,7 @@ class TpuProvider:
             ),
             "recovering": self.recovering,
             "recovered_records": rec.get("records_applied", 0),
+            "geo": None if self.geo is None else self.geo.snapshot(),
         }
 
     def readiness(self) -> dict:
@@ -1489,6 +1530,7 @@ class TpuProvider:
         # in: re-journal them so a crash after this checkpoint still
         # resumes peer retransmission instead of full-resyncing
         self._journal_ack_floors()
+        self._journal_geo_floors()
         # same idiom for the tier demote markers + cold locators
         self.tiers.rejournal()
         return res

@@ -45,6 +45,13 @@ def _env_float(env, name: str, default: float = 0.0) -> float:
         return default
 
 
+def _env_int(env, name: str, default: int = 0) -> int:
+    try:
+        return max(0, int(env.get(name, default)))
+    except (TypeError, ValueError):
+        return default
+
+
 class ChaosConfig:
     """Per-fault probabilities + PRNG seed."""
 
@@ -201,10 +208,23 @@ class NetChaosConfig:
     Env knobs (probabilities in [0, 1], default 0 = disabled):
     ``YTPU_CHAOS_SEED`` plus ``YTPU_CHAOS_NET_DROP``,
     ``YTPU_CHAOS_NET_DELAY``, ``YTPU_CHAOS_NET_DUP``,
-    ``YTPU_CHAOS_NET_REORDER``, ``YTPU_CHAOS_NET_PARTITION``."""
+    ``YTPU_CHAOS_NET_REORDER``, ``YTPU_CHAOS_NET_PARTITION``.
+
+    The WAN profile (ISSUE 17) adds the shapes a LAN mix can't express:
+    ``YTPU_CHAOS_NET_PARTITION_ONEWAY`` (probability of an asymmetric
+    partition window: one direction goes dark, the reverse still
+    flows), ``YTPU_CHAOS_NET_FLAP_TICKS`` (deterministic link flapping:
+    up for 3 N-round windows, down for one, straight off the round
+    counter), ``YTPU_CHAOS_NET_RTT_TICKS`` +
+    ``YTPU_CHAOS_NET_RTT_JITTER_TICKS`` (per-link propagation delay in
+    pump rounds, added to every frame — an RTT distribution, not a
+    fault), and ``YTPU_CHAOS_NET_BW_FRAMES`` (per-direction bandwidth
+    cap in frames per round; excess frames queue to the next round
+    rather than being lost)."""
 
     __slots__ = ("seed", "drop", "delay", "duplicate", "reorder",
-                 "partition")
+                 "partition", "oneway", "flap_ticks", "rtt_ticks",
+                 "rtt_jitter_ticks", "bw_frames")
 
     def __init__(
         self,
@@ -214,6 +234,11 @@ class NetChaosConfig:
         duplicate: float = 0.0,
         reorder: float = 0.0,
         partition: float = 0.0,
+        oneway: float = 0.0,
+        flap_ticks: int = 0,
+        rtt_ticks: int = 0,
+        rtt_jitter_ticks: int = 0,
+        bw_frames: int = 0,
     ):
         self.seed = seed
         self.drop = drop
@@ -221,6 +246,11 @@ class NetChaosConfig:
         self.duplicate = duplicate
         self.reorder = reorder
         self.partition = partition
+        self.oneway = oneway
+        self.flap_ticks = flap_ticks
+        self.rtt_ticks = rtt_ticks
+        self.rtt_jitter_ticks = rtt_jitter_ticks
+        self.bw_frames = bw_frames
 
     @classmethod
     def from_env(cls, env=None) -> "NetChaosConfig":
@@ -236,12 +266,20 @@ class NetChaosConfig:
             duplicate=_env_float(env, "YTPU_CHAOS_NET_DUP"),
             reorder=_env_float(env, "YTPU_CHAOS_NET_REORDER"),
             partition=_env_float(env, "YTPU_CHAOS_NET_PARTITION"),
+            oneway=_env_float(env, "YTPU_CHAOS_NET_PARTITION_ONEWAY"),
+            flap_ticks=_env_int(env, "YTPU_CHAOS_NET_FLAP_TICKS"),
+            rtt_ticks=_env_int(env, "YTPU_CHAOS_NET_RTT_TICKS"),
+            rtt_jitter_ticks=_env_int(
+                env, "YTPU_CHAOS_NET_RTT_JITTER_TICKS"
+            ),
+            bw_frames=_env_int(env, "YTPU_CHAOS_NET_BW_FRAMES"),
         )
 
     def any_faults(self) -> bool:
         return any(
             getattr(self, f) > 0.0
-            for f in ("drop", "delay", "duplicate", "reorder", "partition")
+            for f in ("drop", "delay", "duplicate", "reorder", "partition",
+                      "oneway", "flap_ticks", "rtt_ticks", "bw_frames")
         )
 
     def as_dict(self) -> dict:
@@ -270,13 +308,22 @@ class NetworkFaultInjector:
     """
 
     _NET_FAULTS = ("net_drop", "net_delay", "net_dup", "net_reorder",
-                   "net_partition")
+                   "net_partition", "net_oneway", "net_flap", "net_bw")
 
     def __init__(self, config: NetChaosConfig | None = None):
         self.config = config if config is not None else NetChaosConfig.from_env()
         self.rng = random.Random(self.config.seed)
         self.fault_counts: dict[str, int] = {f: 0 for f in self._NET_FAULTS}
         self._partition_left = 0
+        # one-way partition window (ISSUE 17): frames TOWARD _oneway_dst
+        # are lost while the window is open; the reverse direction (and
+        # every other endpoint) keeps flowing — the asymmetric split a
+        # symmetric partition can't model
+        self._oneway_left = 0
+        self._oneway_dst: str | None = None
+        # endpoint names registered by PipeNetwork.pair, so the one-way
+        # victim is picked deterministically even on idle rounds
+        self._links: list[str] = []
         fam = global_registry().counter(
             "ytpu_chaos_faults_total",
             "Faults injected by the chaos harness, by fault kind",
@@ -305,8 +352,74 @@ class NetworkFaultInjector:
             if cfg.delay and rng.random() < cfg.delay:
                 self._hit("net_delay")
                 delay = 1 + rng.randrange(3)
+            # WAN propagation: every copy pays the link RTT floor plus
+            # per-frame jitter (a latency profile, not a counted fault)
+            if cfg.rtt_ticks:
+                delay += cfg.rtt_ticks
+            if cfg.rtt_jitter_ticks:
+                delay += rng.randrange(cfg.rtt_jitter_ticks + 1)
             out.append(delay)
         return out
+
+    def register_link(self, a_name: str, b_name: str) -> None:
+        """Called by :meth:`PipeNetwork.pair`: remember the endpoint
+        names so one-way partition windows can pick a victim direction
+        deterministically."""
+        for n in (a_name, b_name):
+            if n not in self._links:
+                self._links.append(n)
+
+    def _flap_down(self, rnd: int) -> bool:
+        """Deterministic link flapping straight off the pump-round
+        counter: with ``flap_ticks=N`` the link is up for three N-round
+        windows then down for one (75% duty cycle) — replayable from
+        the round number alone, no RNG draw."""
+        f = self.config.flap_ticks
+        return bool(f) and (rnd % (4 * f)) >= 3 * f
+
+    def _tick_oneway(self, due: list) -> None:
+        cfg = self.config
+        if self._oneway_left > 0:
+            self._oneway_left -= 1
+            if self._oneway_left == 0:
+                self._oneway_dst = None
+            return
+        if not cfg.oneway or self.rng.random() >= cfg.oneway:
+            return
+        names = self._links or sorted({e[1].name for e in due})
+        if not names:
+            return
+        self._oneway_dst = names[self.rng.randrange(len(names))]
+        self._oneway_left = 1 + self.rng.randrange(4)
+
+    def filter_due(self, due: list, rnd: int) -> tuple[list, list]:
+        """Direction-aware WAN shaping for one pump round's due batch.
+        One-way partition windows and flap-down windows LOSE frames
+        (retransmission must heal them); the per-direction bandwidth
+        cap DEFERS excess frames to the next round (queueing delay, not
+        loss).  Returns ``(deliver, defer)``."""
+        cfg = self.config
+        self._tick_oneway(due)
+        flap = self._flap_down(rnd)
+        deliver: list = []
+        defer: list = []
+        sent: dict[str, int] = {}
+        for e in due:
+            name = e[1].name
+            if self._oneway_dst is not None and name == self._oneway_dst:
+                self._hit("net_oneway")
+                continue
+            if flap:
+                self._hit("net_flap")
+                continue
+            n = sent.get(name, 0)
+            if cfg.bw_frames and n >= cfg.bw_frames:
+                self._hit("net_bw")
+                defer.append(e)
+                continue
+            sent[name] = n + 1
+            deliver.append(e)
+        return deliver, defer
 
     def partitioned(self) -> bool:
         """Is the link down this pump round?  Partition windows open

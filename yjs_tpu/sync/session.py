@@ -58,6 +58,7 @@ from ..lib0 import decoding, encoding
 from ..lib0.decoding import Decoder
 from ..lib0.encoding import Encoder
 from ..obs import global_registry
+from ..obs.blackbox import flight_recorder
 from ..obs.dist import (
     TraceContext,
     current_context,
@@ -388,6 +389,18 @@ class SyncSession:
         self.plain_mode = False
         self._peer_enhanced = False
         self._rng = random.Random((self.config.seed << 8) ^ self.sid)
+        # anti-entropy jitter (ISSUE 17): per-peer seeded stream, kept
+        # SEPARATE from the retransmit-backoff RNG so adding digest
+        # jitter never perturbs the pinned backoff sequences.  Same
+        # keyed-stream pattern as the failover FailureDetector; spreads
+        # N links' digests so a partition heal doesn't fire one
+        # synchronized digest storm across every WAN link at once.
+        # Keyed by the stable peer label, NOT the process-global sid:
+        # sids depend on how many sessions existed before this one, so
+        # a sid-keyed stream would make same-seed replays within one
+        # process diverge.
+        self._ae_rng = random.Random(f"ae:{self.config.seed}:{self.peer}")
+        self._ae_jitter = 0
 
         # clocks (ticks)
         self._tick = 0
@@ -561,6 +574,11 @@ class SyncSession:
         enc = self._envelope(K_DIGEST)
         encoding.write_var_uint8_array(enc, self.host.state_vector())
         self._last_digest = self._tick
+        # re-draw the next interval's jitter (0..antientropy/4 ticks)
+        # so consecutive digests desynchronize across sessions even
+        # when they were armed on the same tick (partition heal)
+        span = max(1, self.config.antientropy // 4)
+        self._ae_jitter = self._ae_rng.randrange(span + 1)
         self.metrics.rounds.inc()
         self._send_frame(enc.to_bytes(), "digest")
 
@@ -762,6 +780,17 @@ class SyncSession:
         self._peer_sv = decoding.read_var_uint8_array(dec)
         self._peer_enhanced = True
         self.plain_mode = False
+        # the two directions resume INDEPENDENTLY.  `resumed` judges
+        # the peer's claim about MY send stream; `recv_resumed` is my
+        # own receive-side continuity for the PEER's stream — true when
+        # the HELLO names the sid my receive floor belongs to (a live
+        # floor, or one re-armed from a journaled WAL record).  The
+        # WELCOME must carry `recv_resumed`: it is what tells the peer
+        # to prune-and-retransmit instead of restarting its seq space,
+        # and conflating it with `resumed` makes a recovered region's
+        # peers full-resync whenever the WELCOME races ahead of the
+        # recovered side's own HELLO (reordered or lossy WAN links).
+        recv_resumed = sid == self._peer_sid and sid != 0
         if sid != self._peer_sid:
             # a new peer instance: its receive history died with it
             self._reset_recv(sid)
@@ -781,8 +810,14 @@ class SyncSession:
                 # peer has no memory of our frames: restart the seq
                 # space (the handshake delta below carries all history)
                 self._reset_send()
-        self._count_handshake(resumed)
-        self._send_welcome(resumed)
+        # classify as a resume only when a prior handshake completed —
+        # a duplicate HELLO inside a lossy INITIAL handshake names a
+        # sid we already learned, which is continuity on the wire but
+        # not a resumed session
+        self._count_handshake(
+            resumed and (self.n_resumes + self.n_full_resyncs) > 0
+        )
+        self._send_welcome(recv_resumed)
         self._finish_handshake()
 
     def _on_welcome(self, dec: Decoder) -> None:
@@ -802,7 +837,9 @@ class SyncSession:
                     e["next_retry"] = self._tick
             else:
                 self._reset_send()
-        self._count_handshake(resumed)
+        self._count_handshake(
+            resumed and (self.n_resumes + self.n_full_resyncs) > 0
+        )
         self._finish_handshake()
 
     # -- data / ack ----------------------------------------------------------
@@ -1044,9 +1081,22 @@ class SyncSession:
                 for e in expired:
                     self.n_dead_lettered += 1
                     self.metrics.dead_lettered.inc()
-                    # dead-letter under the frame's own trace context so
-                    # the DLQ seam force-samples the right trace
-                    with use_context(e.get("trace")):
+                    # a retry-capped frame is an acked-loss near-miss on
+                    # a WAN link: force-sample the frame's own trace so
+                    # the drop is always visible in Perfetto/blackbox
+                    # even at production sampling rates, then dead-letter
+                    # under that context so the DLQ seam sees it too
+                    ctx = e.get("trace")
+                    if ctx is not None:
+                        ctx = ctx.force("geo-retry-cap")
+                    flight_recorder().record(
+                        "session", "retry_cap_dead_letter",
+                        severity="warning",
+                        trace=(None if ctx is None else ctx.trace_hex),
+                        peer=self.peer, seq=e["seq"], state=self.state,
+                        attempts=e["attempts"],
+                    )
+                    with use_context(ctx):
                         self.host.dead_letter(
                             e["inner"],
                             f"net-retry-exhausted: seq {e['seq']} after "
@@ -1057,6 +1107,13 @@ class SyncSession:
                 self._last_digest = min(
                     self._last_digest, self._tick - cfg.antientropy
                 )
+                # a WAN storm can dead-letter the ENTIRE initial sync;
+                # syncing -> live otherwise fires only on send/ack
+                # success, and anti-entropy is live-gated — without
+                # this promotion the session wedges in syncing with
+                # the healer that would close the gap never running
+                if self.state == SYNCING and not self._outbox:
+                    self._set_state(LIVE)
         # liveness: nothing heard for the whole window → transport dead
         if (
             cfg.liveness
@@ -1096,7 +1153,7 @@ class SyncSession:
         if (
             cfg.antientropy
             and self.state == LIVE
-            and self._tick - self._last_digest >= cfg.antientropy
+            and self._tick - self._last_digest >= cfg.antientropy + self._ae_jitter
             and not (
                 pol is not None
                 and getattr(pol, "antientropy_paused", False)

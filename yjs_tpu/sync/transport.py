@@ -111,6 +111,11 @@ class PipeNetwork:
         a = PipeTransport(self, a_name)
         b = PipeTransport(self, b_name)
         a.peer, b.peer = b, a
+        # WAN-profile injectors pick one-way partition victims from the
+        # registered endpoint names (getattr: simple test fakes lack it)
+        reg = getattr(self.injector, "register_link", None)
+        if reg is not None:
+            reg(a_name, b_name)
         return a, b
 
     def _enqueue(self, src, dst, frame: bytes) -> None:
@@ -154,6 +159,15 @@ class PipeNetwork:
             partitioned = inj is not None and inj.partitioned()
             if partitioned:
                 continue  # the link is down: everything due is lost
+            # WAN shaping (one-way partitions, flap windows, bandwidth
+            # caps) is direction-aware, so it filters per frame rather
+            # than felling the whole round; over-budget frames re-queue
+            # for the next round instead of being lost
+            filt = getattr(inj, "filter_due", None)
+            if filt is not None:
+                due, defer = filt(due, self.round)
+                for _due_round, dst, frame in defer:
+                    self._inflight.append((self.round + 1, dst, frame))
             if inj is not None and len(due) > 1:
                 due = inj.maybe_reorder(due)
             for _due_round, dst, frame in due:
