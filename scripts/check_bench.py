@@ -14,6 +14,7 @@ Guarded metrics (direction-aware: a *better* number never fails):
     BENCH_overload.json  shed_fraction           higher is better
     BENCH_geo.json       rtt_ms_150.p99_over_floor  lower is better
     BENCH_geo.json       heal.catchup_ms         lower is better
+    BENCH_capacity.json  sessions_per_device     higher is better
 
 Modes:
 
@@ -70,6 +71,18 @@ METRICS = (
      ("rtt_ms_150", "p99_over_floor"), "lower", 1.00),
     ("geo.heal_catchup_ms", "BENCH_geo.json",
      ("heal", "catchup_ms"), "lower", 1.00),
+    # sessions-per-device at interactive SLO (ISSUE 19): the published
+    # capacity figure, knee read from TSDB history.  Wall-clock-SLO
+    # bound, so the band is the widest — the gate catches a halving,
+    # not scheduler jitter
+    ("capacity.sessions_per_device", "BENCH_capacity.json",
+     ("sessions_per_device",), "higher", 0.50),
+    # telemetry overhead (ISSUE 19 pin: < 1% of flush-loop wall).
+    # overhead_pct is instrumented at the obs seams (hook + sampler
+    # perf_counter sums over the run wall), so it is stable on noisy
+    # shared hosts where an A/B wall-clock diff is not
+    ("obs_tsdb.overhead_pct", "BENCH_obs_tsdb.json",
+     ("overhead_pct",), "lower", 1.00),
 )
 
 
@@ -139,6 +152,8 @@ def run_benchmarks(out_dir: Path) -> None:
         bench.bench_overload()
         bench.bench_cluster()
         bench.bench_geo()
+        bench.bench_capacity()
+        bench.bench_obs_tsdb()
     finally:
         os.chdir(cwd)
 

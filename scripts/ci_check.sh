@@ -29,6 +29,18 @@ if [[ "${YTPU_CI_BENCH:-0}" == "1" ]]; then
     python scripts/check_bench.py
 fi
 
+echo "== telemetry history smoke (marker: tsdb) =="
+# the embedded TSDB (ISSUE 19) is the newest subsystem: codec
+# round-trips, downsample-tier oracles, torn-read hammers, and
+# crash-truncation reload regressions surface fast and isolated
+python -m pytest tests/ -q -m 'tsdb and not slow' -p no:cacheprovider
+
+echo "== cost attribution smoke (marker: cost) =="
+# the per-doc/per-tenant cost ledger + capacity model (ISSUE 19):
+# attribution proportionality, top-K cardinality bounds, and the
+# TSDB-derived sessions-per-device knee
+python -m pytest tests/ -q -m 'cost and not slow' -p no:cacheprovider
+
 echo "== geo replication smoke (marker: geo) =="
 # the multi-region active-active suite (ISSUE 17) is the newest
 # subsystem: doc-space codecs, the budgeted WAN delta scheduler,

@@ -40,6 +40,12 @@ Sources (one row per provider):
         renders as a supervision panel above them: per-shard process
         state, restart counts, replay outcomes, and the event tail.
 
+    python scripts/ytpu_top.py --url ... --range ytpu_engine_pending_docs
+        History mode (ISSUE 19): one-shot query of each endpoint's
+        embedded-TSDB ``/query`` (``--last`` seconds, ``--agg``
+        combiner; a supervisor URL answers the federated cross-shard
+        series), rendered as min/max/last plus a sparkline.
+
 Renders with curses on a tty, plain text otherwise (or with ``--plain``);
 ``--once`` prints a single frame and exits (scripting / CI).
 """
@@ -74,7 +80,31 @@ COLUMNS = (
     ("warm", 5),
     ("cold", 5),
     ("brownout", 9),
+    ("trend", 10),
 )
+
+# sparkline glyphs, low to high (the "trend" column and --range mode)
+_SPARK = "▁▂▃▄▅▆▇█"
+# docs/s polls kept per provider row for the trend sparkline
+_TREND_LEN = 10
+
+
+def sparkline(values, width: int | None = None) -> str:
+    """Render a value series as a fixed-width unicode sparkline
+    (newest-last; empty/constant series render as a flat line)."""
+    vals = [float(v) for v in values]
+    if width is not None:
+        vals = vals[-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * len(_SPARK)))]
+        for v in vals
+    )
 
 # per-shard fleet rows (rendered when a snapshot carries a "fleet"
 # block — see FleetRouter.fleet_snapshot / metrics_snapshot)
@@ -128,6 +158,8 @@ GEO_COLUMNS = (
     ("resume", 7),
     ("resync", 7),
     ("dl", 4),
+    ("ship B", 8),
+    ("defer B", 8),
 )
 
 _STATE_NAMES = {0: "ok", 1: "warning", 2: "page"}
@@ -165,6 +197,10 @@ def collect_row(
         docs_rate = max(0.0, docs_flushed - prev["totals"]["docs_flushed"])
         docs_rate /= interval
     conv = _hist(snap, "ytpu_convergence_latency_seconds")
+    # per-row docs/s history feeding the trend sparkline (carried
+    # poll-to-poll through the prev row like the rate totals)
+    history = list((prev or {}).get("history") or ())
+    history = (history + [docs_rate])[-_TREND_LEN:]
     slo = snap.get("slo") or {}
     state = slo.get("state")
     if state is None:
@@ -223,6 +259,8 @@ def collect_row(
                 int((snap.get("admission") or {}).get("level", 0)), "?"
             )
         ),
+        "trend": sparkline(history, _TREND_LEN),
+        "history": history,
         "sessions": [
             {
                 "provider": name,
@@ -290,6 +328,8 @@ def collect_row(
                 "resume": int(ln.get("resumes", 0)),
                 "resync": int(ln.get("full_resyncs", 0)),
                 "dl": int(ln.get("dead_letters", 0)),
+                "ship B": int(ln.get("shipped_bytes", 0)),
+                "defer B": int(ln.get("deferred_bytes", 0)),
             }
             for ln in (snap.get("geo") or {}).get("links", [])
         ],
@@ -386,29 +426,50 @@ def render(rows: list[dict], interval: float) -> str:
 
 
 class FileSource:
-    """Re-reads snapshot JSON files each poll (one provider per file)."""
+    """Polls snapshot JSON files (one provider per file), re-parsing
+    only files whose ``(mtime_ns, size)`` changed since the previous
+    frame — ``--watch``-style loops against slow-moving sidecar dumps
+    stop burning a core re-reading identical JSON (ISSUE 19)."""
 
     def __init__(self, paths: list[str]):
         self.paths = [Path(p) for p in paths]
+        self._cache: dict = {}  # path -> ((mtime_ns, size), snapshot)
 
     def poll(self) -> list[tuple[str, dict]]:
         out = []
         for p in self.paths:
+            stamp = None
+            try:
+                st = p.stat()
+                stamp = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                pass
+            hit = self._cache.get(p)
+            if hit is not None and stamp is not None and hit[0] == stamp:
+                out.append((p.stem, hit[1]))
+                continue
             try:
                 with open(p) as f:
-                    out.append((p.stem, json.load(f)))
+                    snap = json.load(f)
             except (OSError, ValueError):
                 out.append((p.stem, {}))  # unreadable: render an empty row
+                continue
+            if stamp is not None:
+                self._cache[p] = (stamp, snap)
+            out.append((p.stem, snap))
         return out
 
 
 class DirSource:
-    """Federated file mode: every poll re-reads each ``*.json`` in the
+    """Federated file mode: every poll reads each ``*.json`` in the
     directory as one shard's snapshot and prepends a ``FLEET`` row
-    merged across them (``ytpu_top <dir>``)."""
+    merged across them (``ytpu_top <dir>``).  Unchanged files are
+    served from an mtime/size cache (``read_snapshot_dir``'s, ISSUE
+    19) so a watch over a large fleet dir skips the re-parse."""
 
     def __init__(self, path: str):
         self.path = str(path)
+        self._cache: dict = {}
 
     def poll(self) -> list[tuple[str, dict]]:
         from yjs_tpu.obs.federate import (
@@ -416,7 +477,7 @@ class DirSource:
             read_snapshot_dir,
         )
 
-        sources = read_snapshot_dir(self.path)
+        sources = read_snapshot_dir(self.path, cache=self._cache)
         out = [("FLEET", federate_snapshots(sources))]
         for src in sources:
             out.append(
@@ -481,6 +542,7 @@ class ClusterDirSource:
 
     def __init__(self, path: str):
         self.path = str(path)
+        self._cache: dict = {}
 
     def _report(self) -> dict:
         try:
@@ -499,7 +561,7 @@ class ClusterDirSource:
         )
 
         sources = [
-            s for s in read_snapshot_dir(self.path)
+            s for s in read_snapshot_dir(self.path, cache=self._cache)
             if str(s.get("label", "")) != "cluster"
         ]
         out = [("CLUSTER", federate_snapshots(sources))]
@@ -578,6 +640,54 @@ class DemoSource:
         ]
 
 
+# -- history range mode (ISSUE 19) -------------------------------------------
+
+
+def run_range(
+    urls: list[str], name: str, labels: str, last_s: float, agg: str,
+    timeout_s: float = 2.0, out=None,
+) -> int:
+    """``--range``: one shot against each admin endpoint's embedded-TSDB
+    ``/query`` (a supervisor URL answers with the federated cross-shard
+    series), rendered as min/max/last plus a sparkline per endpoint."""
+    from yjs_tpu.obs.tsdb import query_endpoints
+
+    out = out or sys.stdout
+    end = time.time()
+    results = query_endpoints(
+        {u: u for u in urls},
+        {
+            "name": name,
+            "labels": labels,
+            "start": end - last_s,
+            "end": end,
+            "agg": agg,
+        },
+        timeout_s=timeout_s,
+    )
+    out.write(
+        f"ytpu_top --range  {name}"
+        + (f"{{{labels}}}" if labels else "")
+        + f"  last {last_s:g}s  agg={agg}\n"
+    )
+    rc = 1
+    for url in sorted(results):
+        res = results[url]
+        pts = res.get("points") or []
+        if res.get("stale") or not pts:
+            out.write(f"{url:>40}  (no data)\n")
+            continue
+        rc = 0
+        vals = [v for _, v in pts]
+        out.write(
+            f"{url:>40}  n={len(vals):<4d} "
+            f"min={min(vals):<10.4g} max={max(vals):<10.4g} "
+            f"last={vals[-1]:<10.4g} {sparkline(vals, 40)}\n"
+        )
+    out.flush()
+    return rc
+
+
 # -- drivers -----------------------------------------------------------------
 
 
@@ -654,6 +764,20 @@ def main(argv=None) -> int:
     ap.add_argument("--scrape-timeout", type=float, default=2.0,
                     help="per-endpoint HTTP deadline for --url "
                          "(default 2s)")
+    ap.add_argument("--range", metavar="SERIES",
+                    help="history mode (ISSUE 19): query each --url "
+                         "endpoint's embedded-TSDB /query for this "
+                         "series and print min/max/last + a sparkline, "
+                         "then exit")
+    ap.add_argument("--labels", default="",
+                    help="label filter for --range (k=v,k2=v2 form, "
+                         "default: the unlabeled series)")
+    ap.add_argument("--last", type=float, default=3600.0,
+                    help="seconds of history for --range (default 3600)")
+    ap.add_argument("--agg", default="avg",
+                    choices=("avg", "min", "max", "last", "sum", "count"),
+                    help="downsample/federation aggregator for --range "
+                         "(default avg)")
     ap.add_argument("--cluster", action="store_true",
                     help="treat the directory argument as a supervisor "
                          "snapshot drop and render the cluster.json "
@@ -666,6 +790,13 @@ def main(argv=None) -> int:
                     help="plain text frames even on a tty")
     args = ap.parse_args(argv)
 
+    if args.range:
+        if not args.url:
+            ap.error("--range needs at least one --url endpoint")
+        return run_range(
+            args.url, args.range, args.labels, args.last, args.agg,
+            timeout_s=args.scrape_timeout,
+        )
     if args.demo:
         source = DemoSource()
     elif args.url:

@@ -167,6 +167,23 @@ def pytest_configure(config):
         "geo: multi-region replication, WAN chaos convergence, and "
         "partition-recovery tests",
     )
+    # "tsdb" tags the embedded time-series store suite (ISSUE 19) — in
+    # tier-1 by default (injected clocks, tmp-dir persistence),
+    # deselectable with -m 'not tsdb'; ci_check.sh also runs it
+    # standalone first
+    config.addinivalue_line(
+        "markers",
+        "tsdb: embedded TSDB codec, downsampling, persistence, "
+        "torn-read, and range-query tests",
+    )
+    # "cost" tags the cost-attribution ledger suite (ISSUE 19) — in
+    # tier-1 by default (deterministic seams), deselectable with
+    # -m 'not cost'
+    config.addinivalue_line(
+        "markers",
+        "cost: per-doc/per-tenant cost-ledger attribution, top-K "
+        "bounding, and capacity-model tests",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

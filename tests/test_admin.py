@@ -540,7 +540,8 @@ def test_concurrent_scrape_hammer_against_flushing_provider():
 
 
 def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86,
-                     geo_p99=1.27, geo_heal=105.0):
+                     geo_p99=1.27, geo_heal=105.0, capacity=120.0,
+                     obs_pct=0.6):
     (d / "BENCH_planner.json").write_text(
         json.dumps({"cold_vs_warm_ratio": planner})
     )
@@ -558,6 +559,12 @@ def _write_baselines(d, planner=2.0, overlap=0.85, p50=2.5, shed=0.86,
             "rtt_ms_150": {"p99_over_floor": geo_p99},
             "heal": {"catchup_ms": geo_heal},
         })
+    )
+    (d / "BENCH_capacity.json").write_text(
+        json.dumps({"sessions_per_device": capacity})
+    )
+    (d / "BENCH_obs_tsdb.json").write_text(
+        json.dumps({"overhead_pct": obs_pct})
     )
 
 
@@ -586,14 +593,16 @@ def test_check_bench_tolerance_bands(tmp_path):
 
     # better in the metric's own direction never fails
     _write_baselines(fresh, planner=1.0, overlap=0.99, p50=1.0, shed=0.99,
-                     geo_p99=1.0, geo_heal=50.0)
+                     geo_p99=1.0, geo_heal=50.0, capacity=999.0,
+                     obs_pct=0.01)
     assert all(
         v["status"] == "ok" for v in compare(fresh, base, {})
     )
 
     # each metric regressed past its band fails, direction-aware
     _write_baselines(fresh, planner=99.0, overlap=0.1, p50=99.0, shed=0.1,
-                     geo_p99=99.0, geo_heal=9999.0)
+                     geo_p99=99.0, geo_heal=9999.0, capacity=1.0,
+                     obs_pct=99.0)
     verdicts = compare(fresh, base, {})
     assert all(v["status"] == "regression" for v in verdicts)
 
@@ -602,6 +611,7 @@ def test_check_bench_tolerance_bands(tmp_path):
         fresh, planner=2.0 * 1.3, overlap=0.85 * 0.9,
         p50=2.5 * 1.5, shed=0.86 * 0.95,
         geo_p99=1.27 * 1.5, geo_heal=105.0 * 1.8,
+        capacity=120.0 * 0.55, obs_pct=0.6 * 1.9,
     )
     assert all(v["status"] == "ok" for v in compare(fresh, base, {}))
 

@@ -34,6 +34,20 @@ from yjs_tpu.updates import (
 
 pytestmark = pytest.mark.network
 
+
+@pytest.fixture(autouse=True)
+def _pin_sid_counter(monkeypatch):
+    # session sids draw from a module-global counter, and each
+    # session's retransmit-backoff rng is seeded with (seed ^ sid) —
+    # so the storm's jitter sequences silently depend on how many
+    # sessions every EARLIER test in the suite created.  Pin the
+    # counter per test so a failure replays identically in any order.
+    import itertools
+
+    from yjs_tpu.sync import session as session_mod
+
+    monkeypatch.setattr(session_mod, "_SID", itertools.count(1))
+
 # the chaos-suite corpus (test_chaos.py) plus a fresh spread — the
 # acceptance matrix runs the full storm over 20 seeds
 CORPUS_SEEDS = (101, 202, 55, 77)

@@ -42,7 +42,8 @@ RULE_METRIC = "metric-drift"
 KNOB_PREFIXES = (
     "CHAOS", "RESILIENCE", "DLQ", "WAL", "PROF", "SLO", "NET", "FLEET",
     "TIER", "REPL", "FAILOVER", "PLAN", "ADM", "ADMIN", "TRACE",
-    "BLACKBOX", "FLUSH", "LINT", "CLUSTER", "GATEWAY", "GEO",
+    "BLACKBOX", "FLUSH", "LINT", "CLUSTER", "GATEWAY", "GEO", "TSDB",
+    "COST",
 )
 
 KNOB_RE = re.compile(
@@ -318,6 +319,12 @@ def live_comparison(root) -> list:
     from yjs_tpu.geo.replicator import GeoMetrics
 
     GeoMetrics()
+    # ... and the TSDB store families (ISSUE 19): lazily registered by
+    # the first sample/query — touch the holder (the ytpu_cost_*
+    # families register on the provider registry at construction above)
+    from yjs_tpu.obs.tsdb import tsdb_metrics
+
+    tsdb_metrics()
     live = set(prov.engine.obs.registry.names()) | set(
         global_registry().names()
     )

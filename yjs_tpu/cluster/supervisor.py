@@ -946,6 +946,42 @@ class Supervisor:
                     )
         return urls
 
+    def tsdb_query(self, params: dict) -> dict:
+        """Federated ``/query`` (ISSUE 19): fan the range query out to
+        every live shard child's embedded TSDB over the admin plane and
+        merge the per-shard points into one cross-fleet series (dead
+        shards contribute a stale-marked empty result, never an
+        error).  ``?agg=`` doubles as the cross-shard combiner —
+        ``sum`` for fleet totals, ``avg``/``min``/``max`` for spread."""
+        from ..obs.tsdb import merge_points, query_endpoints, tsdb
+
+        urls = {
+            label: url
+            for label, url in self.admin_urls().items()
+            if label != "supervisor"
+        }
+        agg = params.get("agg") or "avg"
+        per_shard = query_endpoints(
+            urls, params, timeout_s=self.config.scrape_timeout_s
+        )
+        merged = merge_points(
+            {k: v.get("points", []) for k, v in per_shard.items()},
+            agg=agg,
+            bucket_s=max(1.0, tsdb().config.interval_s),
+        )
+        return {
+            "name": params.get("name", ""),
+            "labels": params.get("labels", "") or "",
+            "agg": agg,
+            "tier": params.get("tier") or "auto",
+            "federated": True,
+            "shards": sorted(urls),
+            "stale": sorted(
+                k for k, v in per_shard.items() if v.get("stale")
+            ),
+            "points": merged,
+        }
+
     def statusz(self) -> dict:
         report = self.recovery_report()
         return {

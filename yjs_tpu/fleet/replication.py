@@ -211,9 +211,12 @@ class ReplicationManager:
         owner = self.fleet.owner_of(guid)
         if owner is not None:
             try:
-                self._heat[guid] = (
-                    self.fleet.shards[owner].tiers.heat_of(guid)
-                )
+                shard = self.fleet.shards[owner]
+                self._heat[guid] = shard.tiers.heat_of(guid)
+                # cost attribution (ISSUE 19): fan-out bytes land on the
+                # owner's ledger — the doc that wrote is the doc that pays
+                # for every replica copy
+                shard.cost.repl_bytes(guid, len(update) * len(targets))
             except ShardDownError:
                 pass
         for dst in targets:

@@ -296,6 +296,11 @@ class GeoLink:
         # makes scheduled batches incremental between digests
         self._sent_sv: dict[str, dict[int, int]] = {}
         self._budget = 0
+        # cost telemetry (ISSUE 19): docs the byte budget held back —
+        # their bytes count as kind="deferred" when they finally ship
+        self._deferred: set[str] = set()
+        self.shipped_bytes = 0
+        self.deferred_bytes = 0
         # reconnect backoff, seeded per link (the FailureDetector
         # keyed-stream pattern) so N links never stampede a reconnect
         self._rng = random.Random(
@@ -404,6 +409,7 @@ class GeoLink:
             if per_tick and parts and spent >= self._budget:
                 # budget exhausted: everything younger waits its turn
                 metrics.deferrals.inc()
+                self._deferred.update(self._dirty)
                 break
             try:
                 sv = self._doc_sv(guid)
@@ -430,10 +436,46 @@ class GeoLink:
             self._budget = max(0, self._budget - len(payload))
         metrics.delta_frames.inc()
         metrics.delta_bytes.inc(len(payload))
+        self._account_shipment(payload, parts)
         self._send_payload(payload)
 
     def _tick_busy(self, sess) -> bool:
         return sess._tick < sess._busy_until
+
+    def _ledger(self):
+        """The cost ledger behind the region facade, when one exists
+        (a provider facade carries its own; a fleet facade is probed
+        through its first shard — per-link totals, not per-shard)."""
+        facade = self.replicator.facade
+        cost = getattr(facade, "cost", None)
+        if cost is not None:
+            return cost
+        shards = getattr(facade, "shards", None)
+        if shards:
+            try:
+                return getattr(shards[0], "cost", None)
+            except Exception:
+                return None
+        return None
+
+    def _account_shipment(self, payload: bytes,
+                          parts: list[tuple[str, bytes]]) -> None:
+        """Per-link WAN byte telemetry (ISSUE 19 satellite): every
+        payload counts as shipped; parts whose doc the budget deferred
+        earlier additionally count as deferred, now that they left."""
+        cost = self._ledger()
+        self.shipped_bytes += len(payload)
+        if cost is not None:
+            cost.geo_bytes(self.region, len(payload), kind="shipped")
+        late = 0
+        for guid, upd in parts:
+            if guid in self._deferred:
+                self._deferred.discard(guid)
+                late += len(upd)
+        if late:
+            self.deferred_bytes += late
+            if cost is not None:
+                cost.geo_bytes(self.region, late, kind="deferred")
 
     def _doc_sv(self, guid: str) -> dict[int, int] | None:
         try:
@@ -494,6 +536,8 @@ class GeoLink:
             "resumes": sess.n_resumes,
             "full_resyncs": sess.n_full_resyncs,
             "dead_letters": self.n_dead_letters,
+            "shipped_bytes": self.shipped_bytes,
+            "deferred_bytes": self.deferred_bytes,
             "floor": dict(self.floor),
         }
 

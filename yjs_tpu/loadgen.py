@@ -34,7 +34,9 @@ from .resilience.chaos import NetChaosConfig, NetworkFaultInjector
 from .sync.session import DocSessionHost, SessionConfig, SyncSession
 from .sync.transport import PipeNetwork
 
-__all__ = ["LoadGen", "LoadGenConfig", "Profile", "PROFILES"]
+__all__ = [
+    "LoadGen", "LoadGenConfig", "Profile", "PROFILES", "INTERACTIVE_MIX",
+]
 
 _ALPHABET = "abcdefghijklmnopqrstuvwxyz "
 
@@ -93,6 +95,10 @@ _DEFAULT_MIX = (
     ("edit", 4), ("idle", 4), ("reconnect", 1), ("lossy", 1),
     ("abusive", 2),
 )
+
+# all-interactive population for capacity ramps (obs/capacity.py): every
+# session is an editor whose visibility latency the SLO verdict watches
+INTERACTIVE_MIX = (("edit", 1),)
 
 
 class LoadGenConfig:

@@ -70,6 +70,22 @@ from .admin import (  # noqa: F401
     AdminServer,
     maybe_start_admin,
 )
+from .tsdb import (  # noqa: F401
+    Tsdb,
+    TsdbConfig,
+    maybe_attach_tsdb,
+    tsdb,
+    tsdb_enabled,
+    tsdb_metrics,
+    tsdb_window,
+)
+from .cost import CostLedger, cost_enabled  # noqa: F401
+from .capacity import (  # noqa: F401
+    CapacityConfig,
+    ramp_capacity,
+    read_knee,
+    sessions_per_device,
+)
 
 SNAPSHOT_SCHEMA_VERSION = 1
 

@@ -29,6 +29,10 @@ Endpoints::
     /debug/blackbox  flight-recorder ring + stats
     /debug/prof      kernel profile, host-op stats, device-memory gauges
     /debug/trace     bounded recent-span dump (``?n=`` caps the tail)
+    /query           embedded-TSDB range query (ISSUE 19):
+                     ``?name=…&labels=…&start=…&end=…&agg=…&tier=…``;
+                     malformed params answer 400
+    /debug/tsdb      TSDB store stats (series/points/bytes per tier)
 
 Knobs (constructor-overridable, env-derived defaults like
 ``ClusterConfig``): ``YTPU_ADMIN_PORT`` (default 0 = ephemeral),
@@ -138,6 +142,7 @@ def admin_metrics() -> _AdminMetrics:
 _KNOWN_ENDPOINTS = frozenset({
     "/metrics", "/metrics.json", "/healthz", "/readyz", "/statusz",
     "/debug/blackbox", "/debug/prof", "/debug/trace",
+    "/query", "/debug/tsdb",
 })
 
 
@@ -233,6 +238,22 @@ class _Handler(BaseHTTPRequestHandler):
             return 200
         if path == "/debug/prof":
             self._reply_json(200, admin.prof())
+            return 200
+        if path == "/query":
+            from urllib.parse import parse_qs
+
+            params = {
+                k: v[-1] for k, v in parse_qs(query).items() if v
+            }
+            try:
+                result = admin.tsdb_query(params)
+            except ValueError as e:
+                self._reply_json(400, {"error": str(e)})
+                return 400
+            self._reply_json(200, result)
+            return 200
+        if path == "/debug/tsdb":
+            self._reply_json(200, admin.tsdb_stats())
             return 200
         if path == "/debug/trace":
             n = 256
@@ -378,6 +399,26 @@ class AdminServer:
         if fn is not None:
             return fn()
         return []
+
+    def tsdb_query(self, params: dict) -> dict:
+        """``/query``: target override (the supervisor federates shard
+        stores here) falling back to the process-global TSDB."""
+        fn = getattr(self.target, "tsdb_query", None)
+        if fn is not None:
+            return fn(params)
+        from .tsdb import tsdb
+
+        return tsdb().query_params(params)
+
+    def tsdb_stats(self) -> dict:
+        fn = getattr(self.target, "tsdb_stats", None)
+        if fn is not None:
+            return fn()
+        from .tsdb import tsdb, tsdb_enabled
+
+        out = tsdb().stats()
+        out["enabled"] = tsdb_enabled()
+        return out
 
 
 def maybe_start_admin(

@@ -187,6 +187,16 @@ class FlightRecorder:
         }
         if context:
             out["context"] = {k: _jsonable(v) for k, v in context.items()}
+        try:
+            # the metric context leading up to the failure (ISSUE 19):
+            # the last YTPU_BLACKBOX_TSDB_WINDOW_S of key TSDB series
+            from .tsdb import tsdb_window
+
+            win = tsdb_window()
+            if win:
+                out["tsdb"] = win
+        except Exception:
+            pass  # forensics must never take the failing path down
         self._obs()["dumps"].labels(reason=reason).inc()
         self.dumps.append(out)
         directory = os.environ.get("YTPU_BLACKBOX_DIR")

@@ -156,14 +156,20 @@ def federate_snapshots(sources: list[dict],
     }
 
 
-def read_snapshot_dir(path: str) -> list[dict]:
+def read_snapshot_dir(path: str, cache: dict | None = None) -> list[dict]:
     """Load every ``*.json`` metrics snapshot in a directory as a
     federation source (label = file stem, role from the snapshot's own
     ``role`` key when present).  Unreadable files — deleted between
     listdir and open, or caught mid-write — contribute a stale-marked
     empty snapshot and count in
     ``ytpu_fed_scrape_errors_total{mode="file"}``: a dying shard
-    renders a blank row, never crashes the dashboard."""
+    renders a blank row, never crashes the dashboard.
+
+    ``cache`` (caller-owned dict, e.g. one per ytpu_top watcher) skips
+    re-parsing files whose ``(mtime_ns, size)`` did not change since
+    the previous call — a ``--watch`` against a large fleet dir stops
+    re-reading every snapshot every frame (ISSUE 19 satellite).
+    Entries for files that vanished are pruned."""
     sources = []
     try:
         names = sorted(
@@ -171,12 +177,26 @@ def read_snapshot_dir(path: str) -> list[dict]:
         )
     except OSError:
         return sources
+    seen = set()
     for n in names:
         label = n[: -len(".json")]
+        full = os.path.join(path, n)
+        seen.add(full)
+        stamp = None
+        if cache is not None:
+            try:
+                st = os.stat(full)
+                stamp = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                stamp = None
+            hit = cache.get(full)
+            if hit is not None and stamp is not None and hit[0] == stamp:
+                sources.append(hit[1])
+                continue
         snap: dict = {}
         stale = False
         try:
-            with open(os.path.join(path, n)) as f:
+            with open(full) as f:
                 snap = json.load(f)
         except (OSError, ValueError):
             snap = {}
@@ -186,12 +206,20 @@ def read_snapshot_dir(path: str) -> list[dict]:
             stale = True
         if stale:
             fed_metrics().scrape_error("file")
-        sources.append({
+        source = {
             "label": label,
             "role": str(snap.get("role", "") or ""),
             "snapshot": snap,
             "stale": stale,
-        })
+        }
+        # never cache a stale read: the writer may be mid-replace and
+        # the next frame should retry the parse
+        if cache is not None and stamp is not None and not stale:
+            cache[full] = (stamp, source)
+        sources.append(source)
+    if cache is not None:
+        for k in [k for k in cache if k not in seen]:
+            del cache[k]
     return sources
 
 

@@ -196,14 +196,33 @@ class Histogram(_Metric):
         if not self._count:
             return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
                     "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        # all three quantiles from one sorted walk (this sits on the
+        # TSDB sampler tick, which summarizes every histogram)
+        targets = (0.50 * self._count, 0.95 * self._count,
+                   0.99 * self._count)
+        qs = [self._max, self._max, self._max]
+        idx = 0
+        seen = self._zero
+        while idx < 3 and self._zero and seen >= targets[idx]:
+            qs[idx] = self._min
+            idx += 1
+        if idx < 3:
+            for i in sorted(self._buckets):
+                seen += self._buckets[i]
+                while idx < 3 and seen >= targets[idx]:
+                    mid = math.exp((i + 0.5) * _LOG_STEP)
+                    qs[idx] = min(max(mid, self._min), self._max)
+                    idx += 1
+                if idx == 3:
+                    break
         return {
             "count": self._count,
             "sum": self._sum,
             "min": self._min,
             "max": self._max,
-            "p50": self.quantile(0.50),
-            "p95": self.quantile(0.95),
-            "p99": self.quantile(0.99),
+            "p50": qs[0],
+            "p95": qs[1],
+            "p99": qs[2],
         }
 
 

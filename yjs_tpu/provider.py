@@ -25,7 +25,9 @@ from .lib0.encoding import Encoder
 from .lib0 import decoding, encoding
 from .obs import dist as obs_dist
 from .obs.admin import maybe_start_admin
+from .obs.cost import CostLedger
 from .obs.slo import ConvergenceTracker
+from .obs.tsdb import maybe_attach_tsdb
 from .ops.engine import BatchEngine
 from .persistence import (
     KIND_ACK,
@@ -88,6 +90,7 @@ class _ProviderSessionHost:
 
     def handle_frame(self, frame: bytes) -> bytes | None:
         p = self.provider
+        p.cost.session_frame(self.guid)
         try:
             return p.handle_sync_message(self.guid, frame)
         except ProviderFullError as e:
@@ -359,6 +362,13 @@ class TpuProvider:
         # adaptive flush tick (ISSUE 12): paces flush_tick() callers by
         # SLO burn verdict + brownout level; explicit flush() ignores it
         self.flush_ticks = FlushTickController(r)
+        # cost attribution + telemetry history (ISSUE 19): the ledger
+        # rides the ingress/flush/WAL seams below; the embedded TSDB
+        # sampler (one per process) adopts this provider's registry so
+        # its families — ytpu_cost_* included — gain history.  Neither
+        # touches engine state: output is byte-identical on or off.
+        self.cost = CostLedger(r)
+        self.tsdb = maybe_attach_tsdb(r)
         # mid-recovery flag the admin plane's /readyz keys off (ISSUE
         # 16): recover() raises it around the WAL replay
         self.recovering = False
@@ -554,6 +564,7 @@ class TpuProvider:
                 # shedding as an overload signal (self-sustaining
                 # degradation, the flap hysteresis exists to prevent)
                 self.wal.append(KIND_UPDATE, guid, update, v2=v2)
+                self.cost.wal_bytes(guid, len(update))
             self._m_updates_rx.inc()
             self._m_ingress_bytes.inc(len(update))
             adm.enqueue(
@@ -571,6 +582,7 @@ class TpuProvider:
                 # append and flush replays the update; the reverse order
                 # could integrate state the log never saw
                 self.wal.append(KIND_UPDATE, guid, update, v2=v2)
+                self.cost.wal_bytes(guid, len(update))
             accepted = self.engine.queue_update(doc, update, v2=v2)
             self._m_updates_rx.inc()
             self._m_ingress_bytes.inc(len(update))
@@ -578,6 +590,7 @@ class TpuProvider:
                 self.slo.rejected(key)
                 return False
             self.slo.integrated(key)
+            self.cost.staged(guid, len(update))
             self._dirty = True
             ru = self._undo.get(guid)
             if ru is not None:
@@ -609,6 +622,9 @@ class TpuProvider:
             self.slo.rejected(slo_key)
             return False
         self.slo.integrated(slo_key)
+        # journaled (and WAL-costed) at enqueue; staged bytes count now,
+        # when the update actually enters the next flush's batch
+        self.cost.staged(guid, len(update))
         self._dirty = True
         ru = self._undo.get(guid)
         if ru is not None:
@@ -731,6 +747,10 @@ class TpuProvider:
                     # belong INSIDE the flush span: this is the moment
                     # the queued updates became readable
                     self.slo.visible(tracer=tracer)
+                # cost attribution (ISSUE 19): split this flush's
+                # device/host seconds across the docs staged since the
+                # last one, weighted by staged bytes
+                self.cost.on_flush(self.engine.last_flush_metrics)
             except Exception as e:
                 self._dirty = True  # flush incomplete: retry next call
                 # an unhandled flush exception is exactly what the
@@ -1329,6 +1349,7 @@ class TpuProvider:
         snap["sessions"] = self.sessions_snapshot()
         snap["tiers"] = tiers
         snap["admission"] = self.admission.snapshot()
+        snap["cost"] = self.cost.snapshot()
         if self.geo is not None:
             snap["geo"] = self.geo.snapshot()
         return snap
